@@ -1,0 +1,59 @@
+"""ballista-tpu: a TPU-native distributed SQL/DataFrame query engine.
+
+A from-scratch re-design of the capabilities of the reference engine
+(Ballista, a Rust/Arrow distributed query engine "largely inspired by Apache
+Spark" — reference: README.md:57-70, docs/architecture.md:5-46) for TPU
+hardware: query stages compile to single XLA programs over columnar device
+buffers, shuffles ride ICI ``all_to_all`` inside a slice and a host data
+plane across slices, and the scheduler/executor control plane speaks gRPC.
+
+Layering (mirrors reference SURVEY layer map, bottom-up):
+  columnar/datatypes  - fixed-capacity struct-of-arrays batches (L0/L1)
+  expr/logical/sql    - expression AST, logical plan, SQL frontend (L1/L5)
+  physical/kernels    - XLA operator kernels + physical plans (L1)
+  proto/serde         - wire contract (L2)
+  distributed         - scheduler, executor, state, shuffle (L3/L4)
+  client              - BallistaContext / DataFrame API (L5/L6)
+"""
+
+import jax as _jax
+
+# Exact decimal arithmetic uses scaled int64 columns; without x64, JAX would
+# silently downcast them to int32. Float64 device arrays are never created
+# (the engine stores logical f64 as f32 on device; see datatypes.py).
+_jax.config.update("jax_enable_x64", True)
+
+BALLISTA_TPU_VERSION = "0.1.0"
+
+from .datatypes import (  # noqa: E402
+    Boolean,
+    DataType,
+    Date32,
+    Decimal,
+    Field,
+    Float32,
+    Float64,
+    Int32,
+    Int64,
+    Schema,
+    Utf8,
+    schema,
+)
+from .columnar import Column, ColumnBatch, Dictionary  # noqa: E402
+from .expr import (  # noqa: E402
+    avg,
+    case,
+    col,
+    count,
+    count_distinct,
+    date_lit,
+    lit,
+    max_,
+    min_,
+    sum_,
+)
+from .errors import BallistaError  # noqa: E402
+
+
+def print_version() -> None:
+    print(f"ballista-tpu version: {BALLISTA_TPU_VERSION}")

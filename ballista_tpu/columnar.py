@@ -1,0 +1,327 @@
+"""Columnar batch substrate: the unit of data flow between operators.
+
+The reference engine flows Arrow ``RecordBatch`` values between DataFusion
+operators and serializes them via Arrow IPC (reference:
+rust/core/src/utils.rs:49-84, rust/core/src/memory_stream.rs:29-93). On TPU
+the equivalent is a struct-of-arrays batch of *fixed capacity* device buffers
+so every kernel sees static shapes:
+
+- each column is a dense device array of length ``capacity`` (padded);
+- a boolean ``selection`` mask says which physical rows are live — filters
+  only AND into this mask, never compact on device;
+- string columns are dictionary codes (int32) + a host-side interned
+  ``Dictionary``;
+- a batch is a registered JAX pytree, so whole operator pipelines jit/fuse
+  into a single XLA program over its leaves.
+
+Compaction (dropping dead rows) happens only at host boundaries (collect,
+shuffle spill), where numpy boolean indexing is cheap.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .datatypes import DataType, Field, Schema, Utf8
+from .errors import ExecutionError, SchemaError
+
+# Default physical batch capacity (rows). Power of two keeps XLA tilings happy.
+DEFAULT_BATCH_CAPACITY = 1 << 20
+
+
+def round_capacity(n: int, minimum: int = 8) -> int:
+    """Smallest power of two >= n (>= minimum)."""
+    cap = minimum
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+# ---------------------------------------------------------------------------
+# Dictionary (host-side string table)
+# ---------------------------------------------------------------------------
+
+
+class Dictionary:
+    """Interned host-side string table for a dictionary-encoded column.
+
+    Identity-hashed: two scans of the same file share one instance, so it can
+    ride in pytree aux-data without defeating jit caching.
+    """
+
+    __slots__ = ("values", "_index")
+
+    def __init__(self, values: Sequence[str]):
+        self.values: np.ndarray = np.asarray(list(values), dtype=object)
+        self._index: Dict[str, int] = {v: i for i, v in enumerate(self.values)}
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def code_of(self, s: str) -> int:
+        """Code for string s, or -1 if absent (comparison can short-circuit)."""
+        return self._index.get(s, -1)
+
+    def lookup(self, codes: np.ndarray) -> np.ndarray:
+        out = np.empty(len(codes), dtype=object)
+        ok = (codes >= 0) & (codes < len(self.values))
+        out[ok] = self.values[codes[ok]]
+        out[~ok] = None
+        return out
+
+    @staticmethod
+    def encode(strings: Sequence[str]) -> Tuple["Dictionary", np.ndarray]:
+        uniq, codes = np.unique(np.asarray(strings, dtype=object), return_inverse=True)
+        return Dictionary(uniq), codes.astype(np.int32)
+
+    @staticmethod
+    def canonicalize(values: Sequence[str]) -> Tuple["Dictionary", np.ndarray]:
+        """Sorted-unique dictionary + old-code -> new-code remap table.
+
+        Comparison kernels assume dictionaries are sorted and duplicate-free;
+        any derived dictionary (upper/substr/...) must pass through here.
+        """
+        uniq, remap = np.unique(np.asarray(values, dtype=object), return_inverse=True)
+        return Dictionary(uniq), remap.astype(np.int32)
+
+    def __hash__(self) -> int:
+        return id(self)
+
+    def __eq__(self, other) -> bool:
+        return self is other
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"Dictionary({len(self)} values)"
+
+
+# ---------------------------------------------------------------------------
+# Column
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Column:
+    """One physical column: device values + optional validity + dtype."""
+
+    values: jax.Array  # [capacity] device (or numpy pre-transfer)
+    dtype: DataType
+    validity: Optional[jax.Array] = None  # bool [capacity]; None = all valid
+    dictionary: Optional[Dictionary] = None  # only for Utf8
+
+    @property
+    def capacity(self) -> int:
+        return int(self.values.shape[0])
+
+    def valid_mask(self) -> jax.Array:
+        if self.validity is None:
+            return jnp.ones((self.capacity,), dtype=jnp.bool_)
+        return self.validity
+
+    # -- host conversion ----------------------------------------------------
+
+    def to_numpy_logical(self, row_mask: Optional[np.ndarray] = None) -> np.ndarray:
+        """Materialize logical values on host (decodes dicts/decimals)."""
+        vals = np.asarray(self.values)
+        if row_mask is not None:
+            vals = vals[row_mask]
+        if self.dtype.kind == "utf8":
+            if self.dictionary is None:
+                raise ExecutionError("utf8 column without dictionary")
+            return self.dictionary.lookup(vals)
+        if self.dtype.kind == "decimal":
+            return vals.astype(np.float64) / (10.0 ** self.dtype.scale)
+        if self.dtype.kind == "float64":
+            return vals.astype(np.float64)
+        return vals
+
+
+# ---------------------------------------------------------------------------
+# ColumnBatch
+# ---------------------------------------------------------------------------
+
+
+class ColumnBatch:
+    """Fixed-capacity columnar batch; a JAX pytree.
+
+    ``selection`` is the live-row mask (False for filtered-out rows AND for
+    padding beyond the logical row count). ``num_rows`` is a traced i32 scalar
+    with the count of live rows (kept consistent with ``selection`` by
+    constructors; operators that filter must update both).
+    """
+
+    __slots__ = ("schema", "columns", "selection", "num_rows")
+
+    def __init__(
+        self,
+        schema: Schema,
+        columns: Sequence[Column],
+        selection: jax.Array,
+        num_rows: jax.Array,
+    ):
+        self.schema = schema
+        self.columns: Tuple[Column, ...] = tuple(columns)
+        self.selection = selection
+        self.num_rows = num_rows
+        if len(self.columns) != len(schema):
+            raise SchemaError(
+                f"schema has {len(schema)} fields but {len(self.columns)} columns given"
+            )
+
+    # -- constructors -------------------------------------------------------
+
+    @staticmethod
+    def from_numpy(
+        schema: Schema,
+        arrays: Dict[str, np.ndarray],
+        dictionaries: Optional[Dict[str, Dictionary]] = None,
+        capacity: Optional[int] = None,
+    ) -> "ColumnBatch":
+        """Build a batch from host arrays of physical values, padding to capacity."""
+        dictionaries = dictionaries or {}
+        n = None
+        for name, arr in arrays.items():
+            if n is None:
+                n = len(arr)
+            elif len(arr) != n:
+                raise SchemaError(f"column {name} length {len(arr)} != {n}")
+        n = n or 0
+        cap = capacity or round_capacity(n)
+        if cap < n:
+            raise ExecutionError(f"capacity {cap} < rows {n}")
+        cols: List[Column] = []
+        for f in schema.fields:
+            if f.name not in arrays:
+                raise SchemaError(f"missing column {f.name}")
+            arr = np.asarray(arrays[f.name])
+            want = f.dtype.device_dtype()
+            if arr.dtype != want:
+                arr = arr.astype(want)
+            if n < cap:
+                pad = np.zeros(cap - n, dtype=want)
+                arr = np.concatenate([arr, pad])
+            cols.append(
+                Column(jnp.asarray(arr), f.dtype, None, dictionaries.get(f.name))
+            )
+        sel = np.zeros(cap, dtype=np.bool_)
+        sel[:n] = True
+        return ColumnBatch(
+            schema, cols, jnp.asarray(sel), jnp.asarray(np.int32(n))
+        )
+
+    @staticmethod
+    def from_pydict(
+        schema: Schema, data: Dict[str, Sequence], capacity: Optional[int] = None
+    ) -> "ColumnBatch":
+        """Build from logical Python values (strings, floats for decimals...)."""
+        arrays: Dict[str, np.ndarray] = {}
+        dicts: Dict[str, Dictionary] = {}
+        for f in schema.fields:
+            vals = data[f.name]
+            if f.dtype.kind == "utf8":
+                d, codes = Dictionary.encode([str(v) for v in vals])
+                dicts[f.name] = d
+                arrays[f.name] = codes
+            elif f.dtype.kind == "decimal":
+                scale = 10 ** f.dtype.scale
+                arrays[f.name] = np.asarray(
+                    [int(round(float(v) * scale)) for v in vals], dtype=np.int64
+                )
+            else:
+                arrays[f.name] = np.asarray(vals, dtype=f.dtype.device_dtype())
+        return ColumnBatch.from_numpy(schema, arrays, dicts, capacity)
+
+    # -- info ---------------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        return int(self.selection.shape[0])
+
+    def column(self, name: str) -> Column:
+        return self.columns[self.schema.index_of(name)]
+
+    def with_columns(self, schema: Schema, columns: Sequence[Column]) -> "ColumnBatch":
+        return ColumnBatch(schema, columns, self.selection, self.num_rows)
+
+    def with_selection(
+        self, selection: jax.Array, num_rows: Optional[jax.Array] = None
+    ) -> "ColumnBatch":
+        if num_rows is None:
+            num_rows = jnp.sum(selection).astype(jnp.int32)
+        return ColumnBatch(self.schema, self.columns, selection, num_rows)
+
+    # -- host materialization ----------------------------------------------
+
+    def to_pydict(self) -> Dict[str, np.ndarray]:
+        """Compact to host: logical values of live rows only."""
+        mask = np.asarray(self.selection)
+        return {
+            f.name: col.to_numpy_logical(mask)
+            for f, col in zip(self.schema.fields, self.columns)
+        }
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.to_pydict())
+
+    def num_rows_host(self) -> int:
+        return int(self.num_rows)
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return (
+            f"ColumnBatch(cap={self.capacity}, fields={self.schema.names()})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# pytree registration: leaves = device arrays, aux = schema + dicts
+# ---------------------------------------------------------------------------
+
+
+def _flatten_batch(b: ColumnBatch):
+    leaves = []
+    col_meta = []
+    for col in b.columns:
+        leaves.append(col.values)
+        has_validity = col.validity is not None
+        if has_validity:
+            leaves.append(col.validity)
+        col_meta.append((col.dtype, has_validity, col.dictionary))
+    leaves.append(b.selection)
+    leaves.append(b.num_rows)
+    return leaves, (b.schema, tuple(col_meta))
+
+
+def _unflatten_batch(aux, leaves):
+    schema, col_meta = aux
+    leaves = list(leaves)
+    it = iter(leaves)
+    cols = []
+    for dtype, has_validity, dictionary in col_meta:
+        values = next(it)
+        validity = next(it) if has_validity else None
+        cols.append(Column(values, dtype, validity, dictionary))
+    selection = next(it)
+    num_rows = next(it)
+    return ColumnBatch(schema, cols, selection, num_rows)
+
+
+jax.tree_util.register_pytree_node(ColumnBatch, _flatten_batch, _unflatten_batch)
+
+
+# ---------------------------------------------------------------------------
+# Host-side helpers
+# ---------------------------------------------------------------------------
+
+
+def concat_pydicts(parts: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    if not parts:
+        return {}
+    keys = parts[0].keys()
+    return {k: np.concatenate([p[k] for p in parts]) for k in keys}

@@ -1,0 +1,44 @@
+"""Error types for ballista-tpu.
+
+Mirrors the error taxonomy of the reference engine's ``BallistaError`` enum
+(reference: rust/core/src/error.rs:31-163) with Python-idiomatic exception
+classes instead of a Rust enum.
+"""
+
+from __future__ import annotations
+
+
+class BallistaError(Exception):
+    """Base error for all ballista-tpu failures."""
+
+
+class NotImplementedError_(BallistaError):
+    """Feature recognized but not yet supported."""
+
+
+class PlanError(BallistaError):
+    """Logical/physical planning failure (bad column, type mismatch, ...)."""
+
+
+class SqlError(BallistaError):
+    """SQL tokenizing/parsing failure."""
+
+
+class SchemaError(BallistaError):
+    """Schema mismatch or unknown field."""
+
+
+class ExecutionError(BallistaError):
+    """Runtime failure while executing a physical plan."""
+
+
+class SerdeError(BallistaError):
+    """Plan (de)serialization failure."""
+
+
+class IoError(BallistaError):
+    """File/scan/shuffle IO failure."""
+
+
+class ClusterError(BallistaError):
+    """Scheduler/executor control-plane failure."""

@@ -1,0 +1,8 @@
+"""JAX/XLA compute kernels for ballista-tpu physical operators.
+
+These are the TPU-native replacement for DataFusion's Rust compute kernels
+used by the reference's physical operators (reference:
+rust/core/proto/ballista.proto:294-312 lists the 15 operators they power).
+Everything in this package is traceable and composes into one XLA program
+per query stage.
+"""

@@ -1,0 +1,204 @@
+"""Grouped and ungrouped aggregation kernels.
+
+TPU-native replacement for the reference's ``HashAggregateExec`` (reference:
+rust/core/proto/ballista.proto:370-384; planner splits it into
+Partial->shuffle->Final at rust/scheduler/src/planner.rs:149-171 — our
+physical operators follow the same two-phase decomposition).
+
+A CPU hash table is hostile to XLA, so grouping is *sort-based*:
+
+1. pack the group key columns into one int64 composite key;
+2. stable-sort rows by key (dead rows get a +inf sentinel and sink to the
+   end);
+3. run-boundary detection + prefix-sum assigns dense group ids;
+4. ``segment_sum/min/max`` with ``indices_are_sorted=True`` reduces each
+   aggregate in one pass.
+
+Everything is static-shaped: the caller supplies ``group_capacity`` (the max
+number of distinct groups an output batch can carry) and gets fixed-size
+outputs plus a ``group_valid`` mask. Sums over decimals stay in int64, so
+results are exact (TPU f64 is avoided entirely).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..errors import ExecutionError
+
+INT64_SENTINEL = jnp.iinfo(jnp.int64).max
+
+
+# ---------------------------------------------------------------------------
+# Key packing
+# ---------------------------------------------------------------------------
+
+
+def bits_for(n: int) -> int:
+    """Bits needed to represent values in [0, n]."""
+    b = 1
+    while (1 << b) <= n:
+        b += 1
+    return b
+
+
+def pack_keys(columns: Sequence[Tuple[jax.Array, int]]) -> jax.Array:
+    """Pack non-negative int columns (value, bit_width) into one int64 key.
+
+    Total width must be <= 62 (sign bit + sentinel headroom). Values are
+    assumed normalized to [0, 2^width). The first column is the most
+    significant, so packed-key order == lexicographic column order.
+    """
+    total = sum(w for _, w in columns)
+    if total > 62:
+        raise ExecutionError(f"composite group key needs {total} bits > 62")
+    out = None
+    for values, width in columns:
+        v = values.astype(jnp.int64) & ((1 << width) - 1)
+        out = v if out is None else (out << width) | v
+    return out if out is not None else jnp.zeros((), jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# Grouped aggregation
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class AggInput:
+    """One aggregate to compute: op in {sum, count, min, max}."""
+
+    op: str
+    values: Optional[jax.Array]  # None for count(*)
+    validity: Optional[jax.Array]  # None = all valid
+
+
+@dataclass
+class GroupedResult:
+    rep_indices: jax.Array  # int32 [G] original row index of each group's first row
+    group_valid: jax.Array  # bool [G]
+    num_groups: jax.Array  # int32 scalar
+    aggregates: List[jax.Array]  # each [G]
+
+
+jax.tree_util.register_dataclass(
+    GroupedResult,
+    data_fields=["rep_indices", "group_valid", "num_groups", "aggregates"],
+    meta_fields=[],
+)
+
+
+def grouped_aggregate(
+    keys: jax.Array,  # int64 [N] composite group key
+    live: jax.Array,  # bool [N] live-row mask
+    aggs: Sequence[AggInput],
+    group_capacity: int,
+) -> GroupedResult:
+    n = keys.shape[0]
+    keyed = jnp.where(live, keys, INT64_SENTINEL)
+    order = jnp.argsort(keyed, stable=True)  # dead rows sink to the end
+    sk = keyed[order]
+    live_sorted = live[order]
+
+    # a row starts a new group if live and key differs from predecessor
+    first = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sk[1:] != sk[:-1]]
+    )
+    starts = jnp.logical_and(first, live_sorted)
+    gid = jnp.cumsum(starts.astype(jnp.int32)) - 1  # [-1..G-1]
+    num_groups = jnp.sum(starts.astype(jnp.int32))
+    # dead rows / overflow go to the trash segment group_capacity
+    seg = jnp.where(live_sorted, jnp.minimum(gid, group_capacity), group_capacity)
+
+    G = group_capacity
+
+    # representative original-row index per group (first member in sort order)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    first_pos = jax.ops.segment_min(
+        jnp.where(live_sorted, pos, n), seg, num_segments=G + 1,
+        indices_are_sorted=True,
+    )[:G]
+    safe_first = jnp.minimum(first_pos, n - 1)
+    rep_indices = order[safe_first].astype(jnp.int32)
+
+    group_valid = jnp.arange(G, dtype=jnp.int32) < num_groups
+
+    results: List[jax.Array] = []
+    for a in aggs:
+        if a.op == "count":
+            v = jnp.ones((n,), jnp.int64)
+            valid = a.validity[order] if a.validity is not None else None
+            if valid is not None:
+                v = jnp.where(valid, v, 0)
+            r = jax.ops.segment_sum(v, seg, num_segments=G + 1,
+                                    indices_are_sorted=True)[:G]
+        else:
+            if a.values is None:
+                raise ExecutionError(f"{a.op} requires input values")
+            v = a.values[order]
+            valid = a.validity[order] if a.validity is not None else None
+            if a.op == "sum":
+                zero = jnp.zeros((), v.dtype)
+                if valid is not None:
+                    v = jnp.where(valid, v, zero)
+                r = jax.ops.segment_sum(v, seg, num_segments=G + 1,
+                                        indices_are_sorted=True)[:G]
+            elif a.op == "min":
+                ident = _max_ident(v.dtype)
+                if valid is not None:
+                    v = jnp.where(valid, v, ident)
+                r = jax.ops.segment_min(v, seg, num_segments=G + 1,
+                                        indices_are_sorted=True)[:G]
+            elif a.op == "max":
+                ident = _min_ident(v.dtype)
+                if valid is not None:
+                    v = jnp.where(valid, v, ident)
+                r = jax.ops.segment_max(v, seg, num_segments=G + 1,
+                                        indices_are_sorted=True)[:G]
+            else:
+                raise ExecutionError(f"unknown aggregate op {a.op}")
+        results.append(jnp.where(group_valid, r, jnp.zeros((), r.dtype)))
+
+    return GroupedResult(rep_indices, group_valid, num_groups, results)
+
+
+def _max_ident(dt):
+    if jnp.issubdtype(dt, jnp.integer):
+        return jnp.iinfo(dt).max
+    return jnp.asarray(jnp.inf, dt)
+
+
+def _min_ident(dt):
+    if jnp.issubdtype(dt, jnp.integer):
+        return jnp.iinfo(dt).min
+    return jnp.asarray(-jnp.inf, dt)
+
+
+# ---------------------------------------------------------------------------
+# Ungrouped aggregation (whole-batch reductions)
+# ---------------------------------------------------------------------------
+
+
+def scalar_aggregate(live: jax.Array, aggs: Sequence[AggInput]) -> List[jax.Array]:
+    out: List[jax.Array] = []
+    for a in aggs:
+        valid = live
+        if a.validity is not None:
+            valid = jnp.logical_and(valid, a.validity)
+        if a.op == "count":
+            out.append(jnp.sum(valid.astype(jnp.int64)))
+            continue
+        v = a.values
+        if a.op == "sum":
+            out.append(jnp.sum(jnp.where(valid, v, jnp.zeros((), v.dtype))))
+        elif a.op == "min":
+            out.append(jnp.min(jnp.where(valid, v, _max_ident(v.dtype))))
+        elif a.op == "max":
+            out.append(jnp.max(jnp.where(valid, v, _min_ident(v.dtype))))
+        else:
+            raise ExecutionError(f"unknown aggregate op {a.op}")
+    return out

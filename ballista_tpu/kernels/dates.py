@@ -1,0 +1,37 @@
+"""Date arithmetic kernels (days-since-epoch int32 representation).
+
+Uses the standard civil-calendar/days bijection (Howard Hinnant's public
+domain algorithms) expressed in traced integer ops so they fuse into the
+surrounding XLA program.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def civil_from_days(days):
+    """days since 1970-01-01 -> (year, month, day) int32 arrays."""
+    z = days.astype(jnp.int32) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097  # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    year = y + (m <= 2)
+    return year.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def extract_year(days):
+    return civil_from_days(days)[0]
+
+
+def extract_month(days):
+    return civil_from_days(days)[1]
+
+
+def extract_day(days):
+    return civil_from_days(days)[2]

@@ -1,0 +1,533 @@
+"""Traced evaluation of logical expressions against a ColumnBatch.
+
+This is the TPU-native analogue of DataFusion's physical expression layer
+that the reference engine serializes in rust/core/src/serde/physical_plan
+(reference: to_proto.rs:67-331). Instead of a virtual-dispatch interpreter
+over Arrow arrays, expressions are *traced* into the enclosing jit, so a
+whole filter/project pipeline compiles to one fused XLA kernel.
+
+Conventions:
+- decimals are scaled int64; arithmetic tracks scales exactly (see
+  datatypes.py);
+- float64 results are computed/stored as f32 on device (TPU has no fast
+  f64) — exactness-critical reductions stay in int64;
+- utf8 columns are dictionary codes; string predicates (equality, ordering,
+  LIKE, substr...) are evaluated *on the host dictionary once* and become
+  cheap gathers/compares over the codes on device;
+- SQL NULL: validity masks propagate through; predicates treat NULL as
+  False at filter boundaries.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, ColumnBatch, Dictionary
+from ..datatypes import (
+    Boolean,
+    DataType,
+    Date32,
+    Decimal,
+    Field,
+    Float64,
+    Int32,
+    Int64,
+    Schema,
+    Utf8,
+)
+from ..errors import ExecutionError, NotImplementedError_, PlanError
+from .. import expr as ex
+from . import dates as date_kernels
+
+
+@dataclass
+class Evaluated:
+    """Result of evaluating one expression: traced values + metadata."""
+
+    values: jax.Array  # scalar or [capacity]
+    dtype: DataType
+    validity: Optional[jax.Array] = None  # bool, None = all valid
+    dictionary: Optional[Dictionary] = None
+
+    def valid_or(self, cap: int) -> jax.Array:
+        if self.validity is None:
+            return jnp.ones((cap,), dtype=jnp.bool_)
+        return jnp.broadcast_to(self.validity, (cap,))
+
+
+def _and_validity(*vs: Optional[jax.Array]) -> Optional[jax.Array]:
+    present = [v for v in vs if v is not None]
+    if not present:
+        return None
+    out = present[0]
+    for v in present[1:]:
+        out = jnp.logical_and(out, v)
+    return out
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+class Evaluator:
+    """Evaluates logical Exprs against batches of a fixed input schema."""
+
+    def __init__(self, schema: Schema):
+        self.schema = schema
+
+    # ------------------------------------------------------------------ API
+
+    def evaluate(self, e: ex.Expr, batch: ColumnBatch) -> Evaluated:
+        method = getattr(self, "_eval_" + type(e).__name__, None)
+        if method is None:
+            raise NotImplementedError_(f"cannot evaluate {type(e).__name__}")
+        return method(e, batch)
+
+    def evaluate_predicate(self, e: ex.Expr, batch: ColumnBatch) -> jax.Array:
+        """Boolean mask [capacity]; NULL -> False."""
+        r = self.evaluate(e, batch)
+        if r.dtype != Boolean:
+            raise PlanError(f"predicate has type {r.dtype!r}, expected boolean")
+        mask = jnp.broadcast_to(r.values, (batch.capacity,))
+        if r.validity is not None:
+            mask = jnp.logical_and(mask, r.validity)
+        return mask
+
+    def to_column(self, e: ex.Expr, batch: ColumnBatch) -> Column:
+        r = self.evaluate(e, batch)
+        vals = jnp.broadcast_to(r.values, (batch.capacity,))
+        return Column(vals, r.dtype, r.validity, r.dictionary)
+
+    # ----------------------------------------------------------- leaf nodes
+
+    def _eval_ColumnRef(self, e: ex.ColumnRef, batch: ColumnBatch) -> Evaluated:
+        idx = batch.schema.index_of(e.column)
+        col = batch.columns[idx]
+        return Evaluated(col.values, col.dtype, col.validity, col.dictionary)
+
+    def _eval_Literal(self, e: ex.Literal, batch: ColumnBatch) -> Evaluated:
+        if e.value is None:
+            cap = batch.capacity
+            return Evaluated(
+                jnp.zeros((), dtype=e.dtype.device_dtype()),
+                e.dtype,
+                jnp.zeros((cap,), dtype=jnp.bool_),
+            )
+        if e.dtype.kind == "utf8":
+            # bare utf8 literal (e.g. in projection): 1-entry dictionary
+            d = Dictionary([e.value])
+            return Evaluated(jnp.zeros((), jnp.int32), Utf8, None, d)
+        v = e.value
+        if e.dtype.kind == "decimal":
+            v = int(round(float(v) * 10 ** e.dtype.scale))
+        return Evaluated(jnp.asarray(v, dtype=e.dtype.device_dtype()), e.dtype)
+
+    # ------------------------------------------------------------- wrappers
+
+    def _eval_Alias(self, e: ex.Alias, batch: ColumnBatch) -> Evaluated:
+        return self.evaluate(e.expr, batch)
+
+    def _eval_SortExpr(self, e: ex.SortExpr, batch: ColumnBatch) -> Evaluated:
+        return self.evaluate(e.expr, batch)
+
+    def _eval_Not(self, e: ex.Not, batch: ColumnBatch) -> Evaluated:
+        r = self.evaluate(e.expr, batch)
+        return Evaluated(jnp.logical_not(r.values), Boolean, r.validity)
+
+    def _eval_IsNull(self, e: ex.IsNull, batch: ColumnBatch) -> Evaluated:
+        r = self.evaluate(e.expr, batch)
+        if r.validity is None:
+            return Evaluated(jnp.zeros((batch.capacity,), jnp.bool_), Boolean)
+        return Evaluated(jnp.logical_not(r.validity), Boolean)
+
+    def _eval_IsNotNull(self, e: ex.IsNotNull, batch: ColumnBatch) -> Evaluated:
+        r = self.evaluate(e.expr, batch)
+        if r.validity is None:
+            return Evaluated(jnp.ones((batch.capacity,), jnp.bool_), Boolean)
+        return Evaluated(r.validity, Boolean)
+
+    def _eval_Cast(self, e: ex.Cast, batch: ColumnBatch) -> Evaluated:
+        r = self.evaluate(e.expr, batch)
+        return self._cast(r, e.dtype)
+
+    def _cast(self, r: Evaluated, to: DataType) -> Evaluated:
+        if r.dtype == to:
+            return r
+        src, dst = r.dtype, to
+        v = r.values
+        if dst.kind == "decimal":
+            if src.kind == "decimal":
+                shift = dst.scale - src.scale
+                if shift >= 0:
+                    out = v.astype(jnp.int64) * (10 ** shift)
+                else:
+                    out = v.astype(jnp.int64) // (10 ** (-shift))
+            elif src.is_integer:
+                out = v.astype(jnp.int64) * (10 ** dst.scale)
+            elif src.is_floating:
+                out = jnp.round(_f32(v) * (10.0 ** dst.scale)).astype(jnp.int64)
+            else:
+                raise PlanError(f"cast {src!r} -> {dst!r} unsupported")
+            return Evaluated(out, dst, r.validity)
+        if dst.is_floating:
+            if src.kind == "decimal":
+                out = _f32(v) / (10.0 ** src.scale)
+            else:
+                out = _f32(v)
+            return Evaluated(out, dst, r.validity)
+        if dst.is_integer:
+            if src.kind == "decimal":
+                out = (v // (10 ** src.scale)).astype(dst.device_dtype())
+            else:
+                out = v.astype(dst.device_dtype())
+            return Evaluated(out, dst, r.validity)
+        if dst.kind == "date32" and src.is_integer:
+            return Evaluated(v.astype(jnp.int32), dst, r.validity)
+        if dst.kind == "boolean":
+            return Evaluated(v.astype(jnp.bool_), dst, r.validity)
+        raise PlanError(f"cast {src!r} -> {dst!r} unsupported")
+
+    # --------------------------------------------------------------- binary
+
+    def _eval_BinaryExpr(self, e: ex.BinaryExpr, batch: ColumnBatch) -> Evaluated:
+        op = e.op
+        l = self.evaluate(e.left, batch)
+        r = self.evaluate(e.right, batch)
+        validity = _and_validity(l.validity, r.validity)
+
+        if op in ex.BOOL_OPS:
+            # NULL-as-False at boolean combinators (adequate for TPC-H)
+            lv = l.values if l.validity is None else jnp.logical_and(l.values, l.validity)
+            rv = r.values if r.validity is None else jnp.logical_and(r.values, r.validity)
+            fn = jnp.logical_and if op == "and" else jnp.logical_or
+            return Evaluated(fn(lv, rv), Boolean, None)
+
+        if op in ex.CMP_OPS:
+            return self._compare(op, l, r, validity)
+
+        # arithmetic
+        return self._arith(op, l, r, validity)
+
+    # comparison ----------------------------------------------------------
+
+    _CMP = {
+        "=": jnp.equal,
+        "!=": jnp.not_equal,
+        "<": jnp.less,
+        "<=": jnp.less_equal,
+        ">": jnp.greater,
+        ">=": jnp.greater_equal,
+    }
+
+    def _compare(self, op, l: Evaluated, r: Evaluated, validity) -> Evaluated:
+        # utf8 handling
+        if l.dtype.kind == "utf8" or r.dtype.kind == "utf8":
+            return self._compare_utf8(op, l, r, validity)
+        lv, rv = self._coerce_pair(l, r)
+        return Evaluated(self._CMP[op](lv, rv), Boolean, validity)
+
+    def _compare_utf8(self, op, l: Evaluated, r: Evaluated, validity) -> Evaluated:
+        # date column vs string literal
+        if l.dtype.kind == "date32" and r.dtype.kind == "utf8":
+            days = ex.parse_date_literal(self._literal_str(r))
+            return Evaluated(
+                self._CMP[op](l.values, jnp.int32(days)), Boolean, validity
+            )
+        if r.dtype.kind == "date32" and l.dtype.kind == "utf8":
+            days = ex.parse_date_literal(self._literal_str(l))
+            return Evaluated(
+                self._CMP[op](jnp.int32(days), r.values), Boolean, validity
+            )
+        # dict-coded column vs string literal
+        if l.dictionary is not None and r.dictionary is not None:
+            if len(r.dictionary) == 1:  # literal on the right
+                return self._compare_codes_literal(
+                    op, l, r.dictionary.values[0], validity
+                )
+            if len(l.dictionary) == 1:  # literal on the left (flip op)
+                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}
+                return self._compare_codes_literal(
+                    flip[op], r, l.dictionary.values[0], validity
+                )
+            if l.dictionary is r.dictionary:
+                return Evaluated(self._CMP[op](l.values, r.values), Boolean, validity)
+            raise NotImplementedError_(
+                "comparison between differently-encoded utf8 columns"
+            )
+        raise PlanError("utf8 comparison requires dictionary-encoded operands")
+
+    def _compare_codes_literal(self, op, col: Evaluated, s: str, validity) -> Evaluated:
+        d = col.dictionary
+        codes = col.values
+        if op in ("=", "!="):
+            code = d.code_of(s)
+            if code < 0:
+                out = jnp.zeros(codes.shape, jnp.bool_)
+            else:
+                out = jnp.equal(codes, jnp.int32(code))
+            if op == "!=":
+                out = jnp.logical_not(out)
+            return Evaluated(out, Boolean, validity)
+        # ordering against a sorted dictionary: code-space boundary compare
+        lo = int(np.searchsorted(d.values.astype(str), s, side="left"))
+        hi = int(np.searchsorted(d.values.astype(str), s, side="right"))
+        if op == "<":
+            out = codes < lo
+        elif op == "<=":
+            out = codes < hi
+        elif op == ">":
+            out = codes >= hi
+        else:  # >=
+            out = codes >= lo
+        return Evaluated(out, Boolean, validity)
+
+    def _literal_str(self, r: Evaluated) -> str:
+        if r.dictionary is None or len(r.dictionary) != 1:
+            raise PlanError("expected a string literal")
+        return str(r.dictionary.values[0])
+
+    def _coerce_pair(self, l: Evaluated, r: Evaluated):
+        """Coerce two numeric/temporal operands to a directly comparable repr."""
+        a, b = l.dtype, r.dtype
+        if a.kind == "decimal" or b.kind == "decimal":
+            if a.is_floating or b.is_floating:
+                lv = _f32(l.values) / (10.0 ** a.scale) if a.kind == "decimal" else _f32(l.values)
+                rv = _f32(r.values) / (10.0 ** b.scale) if b.kind == "decimal" else _f32(r.values)
+                return lv, rv
+            sa = a.scale if a.kind == "decimal" else 0
+            sb = b.scale if b.kind == "decimal" else 0
+            s = max(sa, sb)
+            lv = l.values.astype(jnp.int64) * (10 ** (s - sa))
+            rv = r.values.astype(jnp.int64) * (10 ** (s - sb))
+            return lv, rv
+        if a.is_floating or b.is_floating:
+            return _f32(l.values), _f32(r.values)
+        if a.kind == "date32" or b.kind == "date32":
+            return l.values.astype(jnp.int32), r.values.astype(jnp.int32)
+        if a.kind == "int64" or b.kind == "int64":
+            return l.values.astype(jnp.int64), r.values.astype(jnp.int64)
+        return l.values, r.values
+
+    # arithmetic -----------------------------------------------------------
+
+    def _arith(self, op, l: Evaluated, r: Evaluated, validity) -> Evaluated:
+        a, b = l.dtype, r.dtype
+        # dates
+        if a.kind == "date32" or b.kind == "date32":
+            lv = l.values.astype(jnp.int32)
+            rv = r.values.astype(jnp.int32)
+            if op == "+":
+                return Evaluated(lv + rv, Date32, validity)
+            if op == "-":
+                out_t = Int32 if (a.kind == b.kind == "date32") else Date32
+                return Evaluated(lv - rv, out_t, validity)
+            raise PlanError(f"op {op} invalid for dates")
+        # decimal exact paths
+        if (a.kind == "decimal" or b.kind == "decimal") and not (
+            a.is_floating or b.is_floating
+        ):
+            sa = a.scale if a.kind == "decimal" else 0
+            sb = b.scale if b.kind == "decimal" else 0
+            lv = l.values.astype(jnp.int64)
+            rv = r.values.astype(jnp.int64)
+            if op in ("+", "-"):
+                s = max(sa, sb)
+                lv = lv * (10 ** (s - sa))
+                rv = rv * (10 ** (s - sb))
+                out = lv + rv if op == "+" else lv - rv
+                return Evaluated(out, Decimal(s), validity)
+            if op == "*":
+                return Evaluated(lv * rv, Decimal(sa + sb), validity)
+            if op == "/":
+                out = (_f32(lv) / (10.0 ** sa)) / (_f32(rv) / (10.0 ** sb))
+                return Evaluated(out, Float64, validity)
+            raise PlanError(f"op {op} unsupported on decimal")
+        # float path (int/int division stays integer, matching the planner's
+        # _arith_result_type: SQL integer division truncates toward zero)
+        int_int = a.is_integer and b.is_integer
+        if a.is_floating or b.is_floating or (op == "/" and not int_int):
+            lv = _f32(l.values) / (10.0 ** a.scale) if a.kind == "decimal" else _f32(l.values)
+            rv = _f32(r.values) / (10.0 ** b.scale) if b.kind == "decimal" else _f32(r.values)
+            out = {"+": jnp.add, "-": jnp.subtract, "*": jnp.multiply,
+                   "/": jnp.divide, "%": jnp.mod}[op](lv, rv)
+            return Evaluated(out, Float64, validity)
+        # integer path
+        out_t = Int64 if (a.kind == "int64" or b.kind == "int64") else Int32
+        lv = l.values.astype(out_t.device_dtype())
+        rv = r.values.astype(out_t.device_dtype())
+        if op == "/":
+            out = jax.lax.div(lv, rv)  # truncating integer division
+        else:
+            out = {"+": jnp.add, "-": jnp.subtract, "*": jnp.multiply,
+                   "%": jnp.mod}[op](lv, rv)
+        return Evaluated(out, out_t, validity)
+
+    # ------------------------------------------------------------ compound
+
+    def _eval_InList(self, e: ex.InList, batch: ColumnBatch) -> Evaluated:
+        base = self.evaluate(e.expr, batch)
+        acc = None
+        for item in e.list:
+            cmp = self._compare("=", base, self.evaluate(item, batch), None)
+            acc = cmp.values if acc is None else jnp.logical_or(acc, cmp.values)
+        if acc is None:
+            acc = jnp.zeros((batch.capacity,), jnp.bool_)
+        if e.negated:
+            acc = jnp.logical_not(acc)
+        return Evaluated(acc, Boolean, base.validity)
+
+    def _eval_Like(self, e: ex.Like, batch: ColumnBatch) -> Evaluated:
+        base = self.evaluate(e.expr, batch)
+        if base.dictionary is None:
+            raise NotImplementedError_("LIKE on non-dictionary column")
+        # SQL LIKE -> regex on the host dictionary, gather match by code
+        pat = re.escape(str(e.pattern)).replace("%", ".*").replace("_", ".")
+        rx = re.compile("^" + pat + "$", re.S)
+        host = np.asarray(
+            [bool(rx.match(str(v))) for v in base.dictionary.values], dtype=np.bool_
+        )
+        out = jnp.take(jnp.asarray(host), base.values.astype(jnp.int32), mode="clip")
+        if e.negated:
+            out = jnp.logical_not(out)
+        return Evaluated(out, Boolean, base.validity)
+
+    def _eval_Case(self, e: ex.Case, batch: ColumnBatch) -> Evaluated:
+        # Evaluate all branches; select with jnp.where chains (traced, fused).
+        conds = []
+        thens = []
+        for w, t in e.branches:
+            if e.base is not None:
+                c = self._eval_BinaryExpr(ex.BinaryExpr(e.base, "=", w), batch)
+            else:
+                c = self.evaluate(w, batch)
+            conds.append(c)
+            thens.append(self.evaluate(t, batch))
+        if e.otherwise is not None:
+            other = self.evaluate(e.otherwise, batch)
+        else:
+            other = Evaluated(
+                jnp.zeros((), thens[0].values.dtype),
+                thens[0].dtype,
+                jnp.zeros((batch.capacity,), jnp.bool_),
+            )
+        out_dtype = thens[0].dtype
+        # normalize all THEN/ELSE branches to out_dtype
+        norm = [self._cast(t, out_dtype) for t in thens]
+        other = self._cast(other, out_dtype)
+        vals = jnp.broadcast_to(other.values, (batch.capacity,))
+        validity = other.validity
+        for c, t in zip(reversed(conds), reversed(norm)):
+            cm = jnp.broadcast_to(c.values, (batch.capacity,))
+            if c.validity is not None:
+                cm = jnp.logical_and(cm, c.validity)
+            vals = jnp.where(cm, jnp.broadcast_to(t.values, (batch.capacity,)), vals)
+            tv = t.valid_or(batch.capacity)
+            ov = validity if validity is not None else jnp.ones(
+                (batch.capacity,), jnp.bool_
+            )
+            validity = jnp.where(cm, tv, ov)
+        return Evaluated(vals, out_dtype, validity)
+
+    # ------------------------------------------------------ scalar functions
+
+    def _eval_ScalarFunction(self, e: ex.ScalarFunction, batch: ColumnBatch) -> Evaluated:
+        fn = e.fn
+        # string functions -> host dictionary transforms
+        if fn in ("upper", "lower", "trim", "ltrim", "rtrim", "substr", "length",
+                  "character_length", "concat"):
+            return self._eval_string_fn(e, batch)
+        if fn in ("extract_year", "extract_month", "extract_day", "date_part"):
+            return self._eval_date_fn(e, batch)
+        args = [self.evaluate(a, batch) for a in e.args]
+        validity = _and_validity(*[a.validity for a in args])
+        if fn == "nullif":
+            eqr = self._compare("=", args[0], args[1], None)
+            base_valid = args[0].valid_or(batch.capacity)
+            new_valid = jnp.logical_and(base_valid, jnp.logical_not(eqr.values))
+            return Evaluated(args[0].values, args[0].dtype, new_valid)
+        if fn == "coalesce":
+            out_dtype = args[0].dtype
+            norm = [self._cast(a, out_dtype) for a in args]
+            out = jnp.broadcast_to(norm[-1].values, (batch.capacity,))
+            validity = norm[-1].validity
+            for a in reversed(norm[:-1]):
+                av = a.valid_or(batch.capacity)
+                out = jnp.where(av, jnp.broadcast_to(a.values, (batch.capacity,)), out)
+                validity = jnp.logical_or(av, validity) if validity is not None else av
+            return Evaluated(out, out_dtype, validity)
+        x = args[0]
+        if fn == "abs":
+            return Evaluated(jnp.abs(x.values), x.dtype, validity)
+        if fn == "signum":
+            return Evaluated(jnp.sign(x.values), x.dtype, validity)
+        # float math
+        xv = _f32(x.values)
+        if x.dtype.kind == "decimal":
+            xv = xv / (10.0 ** x.dtype.scale)
+        jfn = {
+            "sqrt": jnp.sqrt, "exp": jnp.exp, "ln": jnp.log, "log": jnp.log,
+            "log2": jnp.log2, "log10": jnp.log10, "floor": jnp.floor,
+            "ceil": jnp.ceil, "round": jnp.round, "trunc": jnp.trunc,
+            "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan, "asin": jnp.arcsin,
+            "acos": jnp.arccos, "atan": jnp.arctan,
+        }.get(fn)
+        if jfn is None:
+            raise NotImplementedError_(f"scalar function {fn}")
+        return Evaluated(jfn(xv), Float64, validity)
+
+    def _eval_date_fn(self, e: ex.ScalarFunction, batch: ColumnBatch) -> Evaluated:
+        if e.fn == "date_part":
+            part = e.args[0]
+            part_name = part.value if isinstance(part, ex.Literal) else None
+            if part_name is None:
+                raise PlanError("date_part requires a literal part name")
+            x = self.evaluate(e.args[1], batch)
+            fn = {"year": date_kernels.extract_year,
+                  "month": date_kernels.extract_month,
+                  "day": date_kernels.extract_day}[str(part_name).lower()]
+            return Evaluated(fn(x.values), Int32, x.validity)
+        x = self.evaluate(e.args[0], batch)
+        fn = {"extract_year": date_kernels.extract_year,
+              "extract_month": date_kernels.extract_month,
+              "extract_day": date_kernels.extract_day}[e.fn]
+        return Evaluated(fn(x.values), Int32, x.validity)
+
+    def _eval_string_fn(self, e: ex.ScalarFunction, batch: ColumnBatch) -> Evaluated:
+        fn = e.fn
+        if fn == "concat":
+            raise NotImplementedError_("concat over columns (host-side only)")
+        base = self.evaluate(e.args[0], batch)
+        if base.dictionary is None:
+            raise NotImplementedError_(f"{fn} on non-dictionary column")
+        d = base.dictionary
+        if fn in ("length", "character_length"):
+            host = np.asarray([len(str(v)) for v in d.values], dtype=np.int32)
+            out = jnp.take(jnp.asarray(host), base.values.astype(jnp.int32), mode="clip")
+            return Evaluated(out, Int32, base.validity)
+        if fn == "substr":
+            start = e.args[1]
+            length = e.args[2]
+            if not (isinstance(start, ex.Literal) and isinstance(length, ex.Literal)):
+                raise NotImplementedError_("substr with non-literal bounds")
+            s0 = int(start.value) - 1  # SQL 1-based
+            ln = int(length.value)
+            return self._remapped_dict(base, [str(v)[s0 : s0 + ln] for v in d.values])
+        tf = {"upper": str.upper, "lower": str.lower, "trim": str.strip,
+              "ltrim": str.lstrip, "rtrim": str.rstrip}[fn]
+        return self._remapped_dict(base, [tf(str(v)) for v in d.values])
+
+    def _remapped_dict(self, base: Evaluated, new_values) -> Evaluated:
+        # derived dictionaries must stay sorted + duplicate-free for the
+        # comparison kernels; canonicalize and remap the codes
+        newd, remap = Dictionary.canonicalize(new_values)
+        codes = jnp.take(
+            jnp.asarray(remap), base.values.astype(jnp.int32), mode="clip"
+        )
+        return Evaluated(codes, Utf8, base.validity, newd)

@@ -1,0 +1,29 @@
+"""Hash kernels for repartitioning/shuffle.
+
+TPU-native replacement for the reference's hash repartitioning (reference:
+rust/core/proto/ballista.proto:219-230 RepartitionNode, :415-422
+RepartitionExecNode). Uses a splitmix64 finalizer over int64 composite keys;
+the partition id feeds either the host shuffle writer or the in-mesh
+``all_to_all`` fast path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def splitmix64(x: jax.Array) -> jax.Array:
+    """splitmix64 finalizer; good avalanche, pure vector ops."""
+    z = x.astype(jnp.uint64)
+    z = (z + jnp.uint64(0x9E3779B97F4A7C15)) & jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> jnp.uint64(31))
+    return z
+
+
+def hash_partition_ids(keys: jax.Array, num_partitions: int) -> jax.Array:
+    """int64 keys -> int32 partition ids in [0, num_partitions)."""
+    h = splitmix64(keys)
+    return (h % jnp.uint64(num_partitions)).astype(jnp.int32)

@@ -1,0 +1,44 @@
+"""Multi-key sort kernel.
+
+TPU-native replacement for the reference's ``SortExec`` physical operator
+(reference: rust/core/proto/ballista.proto:424-431, SortExecNode). Uses
+chained stable argsorts (least-significant key first), which XLA lowers to
+its native sort; dead (filtered) rows sink to the end so downstream
+operators can keep static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sort_permutation(
+    keys: Sequence[Tuple[jax.Array, bool]],  # (values, ascending), major key first
+    live: jax.Array,
+) -> jax.Array:
+    """Return int32 permutation ordering live rows by keys, dead rows last."""
+    n = live.shape[0]
+    perm = jnp.arange(n, dtype=jnp.int32)
+    # least-significant key first; each pass is stable so earlier keys win
+    for values, ascending in reversed(list(keys)):
+        k = values[perm]
+        k = _orderable(k, ascending)
+        perm = perm[jnp.argsort(k, stable=True)]
+    # final pass: dead rows last (stable keeps the key order among live rows)
+    dead = jnp.logical_not(live)[perm]
+    perm = perm[jnp.argsort(dead, stable=True)]
+    return perm
+
+
+def _orderable(v: jax.Array, ascending: bool) -> jax.Array:
+    if v.dtype == jnp.bool_:
+        v = v.astype(jnp.int32)
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        return v if ascending else -v
+    if ascending:
+        return v
+    # descending integers: flip via bitwise-not to avoid negation overflow
+    return ~v
