@@ -126,19 +126,39 @@ class Column:
     # -- host conversion ----------------------------------------------------
 
     def to_numpy_logical(self, row_mask: Optional[np.ndarray] = None) -> np.ndarray:
-        """Materialize logical values on host (decodes dicts/decimals)."""
+        """Materialize logical values on host (decodes dicts/decimals).
+
+        SQL NULLs (validity False) become None for strings and NaN for
+        numerics — integer columns with NULLs widen to float64, matching
+        pandas conventions.
+        """
         vals = np.asarray(self.values)
+        invalid = None
+        if self.validity is not None:
+            invalid = ~np.asarray(self.validity)
         if row_mask is not None:
             vals = vals[row_mask]
+            if invalid is not None:
+                invalid = invalid[row_mask]
+        has_nulls = invalid is not None and bool(invalid.any())
         if self.dtype.kind == "utf8":
             if self.dictionary is None:
                 raise ExecutionError("utf8 column without dictionary")
-            return self.dictionary.lookup(vals)
+            out = self.dictionary.lookup(vals)
+            if has_nulls:
+                out[invalid] = None
+            return out
         if self.dtype.kind == "decimal":
-            return vals.astype(np.float64) / (10.0 ** self.dtype.scale)
-        if self.dtype.kind == "float64":
-            return vals.astype(np.float64)
-        return vals
+            out = vals.astype(np.float64) / (10.0 ** self.dtype.scale)
+        elif self.dtype.is_floating:
+            out = vals.astype(np.float64)
+        elif has_nulls:
+            out = vals.astype(np.float64)
+        else:
+            return vals
+        if has_nulls:
+            out[invalid] = np.nan
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,41 @@
+"""Single-process plan execution helpers.
+
+The in-process equivalent of the reference's executor collect path
+(reference: rust/executor/src/collect.rs:35-121 CollectExec merges all
+partitions into one stream). Used by tests, the standalone client mode,
+and executors running one task.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from .columnar import concat_pydicts
+from .logical import LogicalPlan
+from .optimizer import optimize
+from .physical.base import PhysicalPlan
+from .physical.planner import create_physical_plan
+
+
+def plan_logical(plan: LogicalPlan) -> PhysicalPlan:
+    return create_physical_plan(optimize(plan))
+
+
+def collect_physical(phys: PhysicalPlan) -> Dict[str, np.ndarray]:
+    """Execute all partitions and concatenate live rows on host."""
+    parts: List[Dict[str, np.ndarray]] = []
+    for p in range(phys.output_partitioning().num_partitions):
+        for batch in phys.execute(p):
+            parts.append(batch.to_pydict())
+    if not parts:
+        return {f.name: np.asarray([]) for f in phys.output_schema().fields}
+    return concat_pydicts(parts)
+
+
+def collect(plan: LogicalPlan):
+    """Logical plan -> pandas DataFrame (optimize, plan, execute, gather)."""
+    import pandas as pd
+
+    return pd.DataFrame(collect_physical(plan_logical(plan)))

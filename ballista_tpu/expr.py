@@ -526,6 +526,10 @@ class AggregateExpr(Expr):
             return Field(self.name(), Int64, False)
         inner = self.expr.to_field(schema)
         if self.fn == "avg":
+            # exact-ish fixed-point average for int/decimal inputs: TPU has
+            # no fast f64, so sum stays int64 and avg is scaled to 6 dp
+            if inner.dtype.is_integer or inner.dtype.kind == "decimal":
+                return Field(self.name(), Decimal(6), True)
             return Field(self.name(), Float64, True)
         if self.fn == "sum":
             dt = inner.dtype

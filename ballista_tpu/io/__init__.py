@@ -1,0 +1,12 @@
+"""Table sources: memory, delimited text (.tbl/.csv), Parquet.
+
+TPU-native replacement for the reference's scan layer (reference:
+rust/core/proto/ballista.proto:334-354 CsvScan/ParquetScan nodes; client
+registration at rust/client/src/context.rs:88-129). Sources produce
+fixed-capacity ColumnBatches with interned per-table dictionaries so
+string comparisons stay ordinal across all partitions.
+"""
+
+from .memory import MemTableSource  # noqa: F401
+from .text import CsvSource, TblSource  # noqa: F401
+from .parquet import ParquetSource  # noqa: F401

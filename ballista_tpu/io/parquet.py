@@ -1,0 +1,145 @@
+"""Parquet table source (via pyarrow).
+
+Equivalent of the reference's ParquetScan + GetFileMetadata surface
+(reference: rust/core/proto/ballista.proto:348-354, rust/scheduler/src/
+lib.rs:184-222). One partition per file (directory datasets) or per
+row-group chunk of a single file.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..columnar import ColumnBatch, Dictionary, DEFAULT_BATCH_CAPACITY, round_capacity
+from ..datatypes import (
+    Boolean,
+    DataType,
+    Date32,
+    Decimal,
+    Field,
+    Float32,
+    Float64,
+    Int32,
+    Int64,
+    Schema,
+    Utf8,
+)
+from ..errors import IoError
+from ..logical import TableSource
+
+
+def _arrow_to_dtype(t) -> DataType:
+    import pyarrow as pa
+
+    if pa.types.is_int64(t) or pa.types.is_uint32(t):
+        return Int64
+    if pa.types.is_integer(t):
+        return Int32
+    if pa.types.is_float64(t):
+        return Float64
+    if pa.types.is_floating(t):
+        return Float32
+    if pa.types.is_boolean(t):
+        return Boolean
+    if pa.types.is_decimal(t):
+        return Decimal(t.scale)
+    if pa.types.is_date(t):
+        return Date32
+    if pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_dictionary(t):
+        return Utf8
+    raise IoError(f"unsupported parquet type {t}")
+
+
+class ParquetSource(TableSource):
+    def __init__(self, path: str, schema: Optional[Schema] = None,
+                 batch_capacity: int = DEFAULT_BATCH_CAPACITY):
+        import pyarrow.parquet as pq
+
+        self._path = path
+        if os.path.isdir(path):
+            self._files = sorted(
+                os.path.join(path, f) for f in os.listdir(path)
+                if f.endswith(".parquet")
+            )
+            if not self._files:
+                raise IoError(f"no parquet files under {path}")
+        else:
+            self._files = [path]
+        self._capacity = batch_capacity
+        pf = pq.ParquetFile(self._files[0])
+        arrow_schema = pf.schema_arrow
+        if schema is None:
+            fields = [
+                Field(n, _arrow_to_dtype(arrow_schema.field(n).type), True)
+                for n in arrow_schema.names
+            ]
+            schema = Schema(fields)
+        self._schema = schema
+        self._dicts: Dict[str, Dictionary] = {}
+
+    def table_schema(self) -> Schema:
+        return self._schema
+
+    def num_partitions(self) -> int:
+        return len(self._files)
+
+    def source_descriptor(self) -> dict:
+        return {"kind": "parquet", "path": self._path}
+
+    def _dictionary_for(self, colname: str) -> Dictionary:
+        import pyarrow.parquet as pq
+
+        if colname in self._dicts:
+            return self._dicts[colname]
+        uniq: Optional[np.ndarray] = None
+        for f in self._files:
+            t = pq.read_table(f, columns=[colname])
+            vals = np.asarray(t.column(0).to_pylist(), dtype=object)
+            u = np.unique(vals)
+            uniq = u if uniq is None else np.unique(np.concatenate([uniq, u]))
+        d = Dictionary(uniq if uniq is not None else [])
+        self._dicts[colname] = d
+        return d
+
+    def scan(self, partition: int, projection: Optional[Sequence[str]] = None):
+        import pyarrow.parquet as pq
+
+        names = list(projection) if projection is not None else list(self._schema.names())
+        sub_schema = self._schema.project(names)
+        table = pq.read_table(self._files[partition], columns=names)
+        n = table.num_rows
+        arrays: Dict[str, np.ndarray] = {}
+        dicts: Dict[str, Dictionary] = {}
+        for name in names:
+            field = self._schema.field(name)
+            colarr = table.column(name)
+            if field.dtype.kind == "utf8":
+                d = self._dictionary_for(name)
+                vals = np.asarray(colarr.to_pylist(), dtype=object)
+                codes = np.searchsorted(d.values.astype(str), vals.astype(str))
+                arrays[name] = codes.astype(np.int32)
+                dicts[name] = d
+            elif field.dtype.kind == "decimal":
+                scale = 10 ** field.dtype.scale
+                vals = colarr.cast("float64").to_numpy(zero_copy_only=False)
+                arrays[name] = np.round(vals * scale).astype(np.int64)
+            elif field.dtype.kind == "date32":
+                arrays[name] = colarr.cast("int32").to_numpy(zero_copy_only=False)
+            else:
+                arrays[name] = colarr.to_numpy(zero_copy_only=False).astype(
+                    field.dtype.device_dtype()
+                )
+        cap = min(self._capacity, round_capacity(max(n, 1)))
+        start = 0
+        emitted = False
+        while start < n or not emitted:
+            end = min(start + cap, n)
+            chunk = {k: v[start:end] for k, v in arrays.items()}
+            yield ColumnBatch.from_numpy(sub_schema, chunk, dicts, capacity=cap)
+            emitted = True
+            start = end
+            if start >= n:
+                break

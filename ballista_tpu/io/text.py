@@ -1,0 +1,172 @@
+"""Delimited text sources: TPC-H ``.tbl`` ('|'-separated) and CSV.
+
+Equivalent of the reference's CSV scan path (reference:
+rust/client/src/context.rs:88-108 read_csv; benchmark .tbl registration at
+rust/benchmarks/tpch/src/main.rs:128-155). Parsing currently rides pandas'
+C reader; the native C++ scanner in ballista_tpu/native replaces it on the
+hot path when built.
+
+Partitioning: a directory scans one file per partition (the reference's
+testdata layout, rust/scheduler/testdata/*/partition{0,1}.tbl); a single
+file is one partition, optionally chunked into multiple batches.
+
+Dictionaries are built lazily per string column over ALL partitions at
+first use (sorted + interned), so codes are ordinal and comparable across
+every batch of the table.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..columnar import ColumnBatch, Dictionary, DEFAULT_BATCH_CAPACITY, round_capacity
+from ..datatypes import Schema
+from ..errors import IoError
+from ..logical import TableSource
+
+
+def _list_files(path: str, suffixes=(".tbl", ".csv", ".txt", ".dat")) -> List[str]:
+    if os.path.isdir(path):
+        out = sorted(
+            os.path.join(path, f)
+            for f in os.listdir(path)
+            if f.endswith(suffixes) or "." not in f
+        )
+        if not out:
+            raise IoError(f"no data files under {path}")
+        return out
+    if not os.path.exists(path):
+        raise IoError(f"no such path: {path}")
+    return [path]
+
+
+class DelimitedSource(TableSource):
+    def __init__(
+        self,
+        path: str,
+        schema: Schema,
+        delimiter: str,
+        has_header: bool = False,
+        trailing_delimiter: bool = False,
+        batch_capacity: int = DEFAULT_BATCH_CAPACITY,
+    ):
+        self._path = path
+        self._schema = schema
+        self._delim = delimiter
+        self._header = has_header
+        self._trailing = trailing_delimiter
+        self._capacity = batch_capacity
+        self._files = _list_files(path)
+        self._dicts: Dict[str, Dictionary] = {}
+
+    # -- TableSource --------------------------------------------------------
+
+    def table_schema(self) -> Schema:
+        return self._schema
+
+    def num_partitions(self) -> int:
+        return len(self._files)
+
+    def source_descriptor(self) -> dict:
+        return {
+            "kind": "tbl" if self._delim == "|" else "csv",
+            "path": self._path,
+            "delimiter": self._delim,
+            "has_header": self._header,
+        }
+
+    # -- scanning -----------------------------------------------------------
+
+    def _read_pandas(self, path: str, names: List[str], usecols: List[int]):
+        import pandas as pd
+
+        return pd.read_csv(
+            path,
+            sep=self._delim,
+            header=0 if self._header else None,
+            names=names,
+            usecols=usecols,
+            engine="c",
+            skipinitialspace=False,
+        )
+
+    def _column_names(self) -> List[str]:
+        names = list(self._schema.names())
+        if self._trailing:
+            names = names + ["__trailing__"]
+        return names
+
+    def _dictionary_for(self, colname: str) -> Dictionary:
+        """Global sorted dictionary over all partitions (built once)."""
+        if colname in self._dicts:
+            return self._dicts[colname]
+        idx = self._schema.index_of(colname)
+        uniq: Optional[np.ndarray] = None
+        for f in self._files:
+            df = self._read_pandas(f, self._column_names(), [idx])
+            vals = df[colname].astype(str).to_numpy(dtype=object)
+            u = np.unique(vals)
+            uniq = u if uniq is None else np.unique(np.concatenate([uniq, u]))
+        d = Dictionary(uniq if uniq is not None else [])
+        self._dicts[colname] = d
+        return d
+
+    def scan(self, partition: int, projection: Optional[Sequence[str]] = None):
+        names = projection if projection is not None else self._schema.names()
+        sub_schema = self._schema.project(names)
+        idxs = [self._schema.index_of(n) for n in names]
+        df = self._read_pandas(self._files[partition], self._column_names(), idxs)
+        n = len(df)
+        arrays: Dict[str, np.ndarray] = {}
+        dicts: Dict[str, Dictionary] = {}
+        for name in names:
+            field = self._schema.field(name)
+            raw = df[name]  # pandas labels used columns by the given names
+            if field.dtype.kind == "utf8":
+                d = self._dictionary_for(name)
+                vals = raw.astype(str).to_numpy(dtype=object)
+                codes = np.searchsorted(d.values.astype(str), vals.astype(str))
+                arrays[name] = codes.astype(np.int32)
+                dicts[name] = d
+            elif field.dtype.kind == "decimal":
+                scale = 10 ** field.dtype.scale
+                arrays[name] = np.round(
+                    raw.to_numpy(dtype=np.float64) * scale
+                ).astype(np.int64)
+            elif field.dtype.kind == "date32":
+                vals = raw.astype(str).to_numpy(dtype="datetime64[D]")
+                arrays[name] = vals.astype(np.int32)
+            else:
+                arrays[name] = raw.to_numpy(dtype=field.dtype.device_dtype())
+        # chunk into fixed-capacity batches
+        cap = min(self._capacity, round_capacity(max(n, 1)))
+        start = 0
+        emitted = False
+        while start < n or not emitted:
+            end = min(start + cap, n)
+            chunk = {k: v[start:end] for k, v in arrays.items()}
+            yield ColumnBatch.from_numpy(sub_schema, chunk, dicts, capacity=cap)
+            emitted = True
+            start = end
+            if start >= n:
+                break
+
+
+class TblSource(DelimitedSource):
+    """TPC-H dbgen output: '|' separated, trailing '|', no header."""
+
+    def __init__(self, path: str, schema: Schema,
+                 batch_capacity: int = DEFAULT_BATCH_CAPACITY):
+        super().__init__(path, schema, "|", has_header=False,
+                         trailing_delimiter=True, batch_capacity=batch_capacity)
+
+
+class CsvSource(DelimitedSource):
+    def __init__(self, path: str, schema: Schema, has_header: bool = True,
+                 delimiter: str = ",",
+                 batch_capacity: int = DEFAULT_BATCH_CAPACITY):
+        super().__init__(path, schema, delimiter, has_header=has_header,
+                         trailing_delimiter=False, batch_capacity=batch_capacity)

@@ -7,17 +7,26 @@ physical operators follow the same two-phase decomposition).
 
 A CPU hash table is hostile to XLA, so grouping is *sort-based*:
 
-1. pack the group key columns into one int64 composite key;
-2. stable-sort rows by key (dead rows get a +inf sentinel and sink to the
-   end);
-3. run-boundary detection + prefix-sum assigns dense group ids;
-4. ``segment_sum/min/max`` with ``indices_are_sorted=True`` reduces each
+1. rows are ordered by chained stable argsorts, least-significant key first
+   (no bit-packing, so any number/width of key columns works); a final
+   stable sort on the live flag sinks dead rows to the end;
+2. run-boundary detection (ANY key differs from the predecessor) + a prefix
+   sum assigns dense group ids;
+3. ``segment_sum/min/max`` with ``indices_are_sorted=True`` reduces each
    aggregate in one pass.
 
-Everything is static-shaped: the caller supplies ``group_capacity`` (the max
-number of distinct groups an output batch can carry) and gets fixed-size
-outputs plus a ``group_valid`` mask. Sums over decimals stay in int64, so
-results are exact (TPU f64 is avoided entirely).
+SQL semantics carried through:
+- NULL group keys form their own group (each key column contributes its
+  validity as an implicit sort/boundary key);
+- NULL inputs are excluded from aggregates, and each aggregate reports a
+  per-group validity ("any non-NULL input seen"), so all-NULL groups yield
+  NULL rather than the reduction identity.
+
+Everything is static-shaped: the caller supplies ``group_capacity`` and gets
+fixed-size outputs plus a ``group_valid`` mask; ``num_groups`` reports the
+TRUE group count so callers can detect overflow and retry with a larger
+capacity. Sums over decimals stay in int64, so results are exact (TPU f64
+is avoided entirely).
 """
 
 from __future__ import annotations
@@ -29,38 +38,6 @@ import jax
 import jax.numpy as jnp
 
 from ..errors import ExecutionError
-
-INT64_SENTINEL = jnp.iinfo(jnp.int64).max
-
-
-# ---------------------------------------------------------------------------
-# Key packing
-# ---------------------------------------------------------------------------
-
-
-def bits_for(n: int) -> int:
-    """Bits needed to represent values in [0, n]."""
-    b = 1
-    while (1 << b) <= n:
-        b += 1
-    return b
-
-
-def pack_keys(columns: Sequence[Tuple[jax.Array, int]]) -> jax.Array:
-    """Pack non-negative int columns (value, bit_width) into one int64 key.
-
-    Total width must be <= 62 (sign bit + sentinel headroom). Values are
-    assumed normalized to [0, 2^width). The first column is the most
-    significant, so packed-key order == lexicographic column order.
-    """
-    total = sum(w for _, w in columns)
-    if total > 62:
-        raise ExecutionError(f"composite group key needs {total} bits > 62")
-    out = None
-    for values, width in columns:
-        v = values.astype(jnp.int64) & ((1 << width) - 1)
-        out = v if out is None else (out << width) | v
-    return out if out is not None else jnp.zeros((), jnp.int64)
 
 
 # ---------------------------------------------------------------------------
@@ -81,33 +58,55 @@ class AggInput:
 class GroupedResult:
     rep_indices: jax.Array  # int32 [G] original row index of each group's first row
     group_valid: jax.Array  # bool [G]
-    num_groups: jax.Array  # int32 scalar
+    num_groups: jax.Array  # int32 scalar (TRUE count; may exceed capacity G)
     aggregates: List[jax.Array]  # each [G]
+    agg_valid: List[jax.Array]  # bool [G] per aggregate ("any input seen")
 
 
 jax.tree_util.register_dataclass(
     GroupedResult,
-    data_fields=["rep_indices", "group_valid", "num_groups", "aggregates"],
+    data_fields=["rep_indices", "group_valid", "num_groups", "aggregates",
+                 "agg_valid"],
     meta_fields=[],
 )
 
 
 def grouped_aggregate(
-    keys: jax.Array,  # int64 [N] composite group key
+    keys: Sequence[jax.Array],  # one or more [N] key columns (ints/codes)
     live: jax.Array,  # bool [N] live-row mask
     aggs: Sequence[AggInput],
     group_capacity: int,
+    key_validities: Optional[Sequence[Optional[jax.Array]]] = None,
 ) -> GroupedResult:
-    n = keys.shape[0]
-    keyed = jnp.where(live, keys, INT64_SENTINEL)
-    order = jnp.argsort(keyed, stable=True)  # dead rows sink to the end
-    sk = keyed[order]
+    keys = list(keys)
+    if not keys:
+        raise ExecutionError("grouped_aggregate requires at least one key")
+    if key_validities is None:
+        key_validities = [None] * len(keys)
+    # NULL keys group together: each nullable key contributes (validity,
+    # value-or-0) as the effective sort/boundary pair
+    eff_keys: List[jax.Array] = []
+    for k, kv in zip(keys, key_validities):
+        if kv is not None:
+            eff_keys.append(kv.astype(jnp.int32))
+            eff_keys.append(jnp.where(kv, k, jnp.zeros((), k.dtype)))
+        else:
+            eff_keys.append(k)
+
+    n = live.shape[0]
+    order = jnp.arange(n, dtype=jnp.int32)
+    for k in reversed(eff_keys):
+        order = order[jnp.argsort(k[order], stable=True)]
+    dead = jnp.logical_not(live)
+    order = order[jnp.argsort(dead[order], stable=True)]
     live_sorted = live[order]
 
-    # a row starts a new group if live and key differs from predecessor
-    first = jnp.concatenate(
-        [jnp.ones((1,), jnp.bool_), sk[1:] != sk[:-1]]
-    )
+    # a row starts a new group if live and ANY key differs from predecessor
+    first = None
+    for k in eff_keys:
+        ks = k[order]
+        diff = jnp.concatenate([jnp.ones((1,), jnp.bool_), ks[1:] != ks[:-1]])
+        first = diff if first is None else jnp.logical_or(first, diff)
     starts = jnp.logical_and(first, live_sorted)
     gid = jnp.cumsum(starts.astype(jnp.int32)) - 1  # [-1..G-1]
     num_groups = jnp.sum(starts.astype(jnp.int32))
@@ -128,42 +127,50 @@ def grouped_aggregate(
     group_valid = jnp.arange(G, dtype=jnp.int32) < num_groups
 
     results: List[jax.Array] = []
+    valid_results: List[jax.Array] = []
     for a in aggs:
+        valid = a.validity[order] if a.validity is not None else None
         if a.op == "count":
             v = jnp.ones((n,), jnp.int64)
-            valid = a.validity[order] if a.validity is not None else None
             if valid is not None:
                 v = jnp.where(valid, v, 0)
             r = jax.ops.segment_sum(v, seg, num_segments=G + 1,
                                     indices_are_sorted=True)[:G]
+            va = group_valid
         else:
             if a.values is None:
                 raise ExecutionError(f"{a.op} requires input values")
             v = a.values[order]
-            valid = a.validity[order] if a.validity is not None else None
             if a.op == "sum":
-                zero = jnp.zeros((), v.dtype)
                 if valid is not None:
-                    v = jnp.where(valid, v, zero)
+                    v = jnp.where(valid, v, jnp.zeros((), v.dtype))
                 r = jax.ops.segment_sum(v, seg, num_segments=G + 1,
                                         indices_are_sorted=True)[:G]
             elif a.op == "min":
-                ident = _max_ident(v.dtype)
                 if valid is not None:
-                    v = jnp.where(valid, v, ident)
+                    v = jnp.where(valid, v, _max_ident(v.dtype))
                 r = jax.ops.segment_min(v, seg, num_segments=G + 1,
                                         indices_are_sorted=True)[:G]
             elif a.op == "max":
-                ident = _min_ident(v.dtype)
                 if valid is not None:
-                    v = jnp.where(valid, v, ident)
+                    v = jnp.where(valid, v, _min_ident(v.dtype))
                 r = jax.ops.segment_max(v, seg, num_segments=G + 1,
                                         indices_are_sorted=True)[:G]
             else:
                 raise ExecutionError(f"unknown aggregate op {a.op}")
-        results.append(jnp.where(group_valid, r, jnp.zeros((), r.dtype)))
+            if valid is not None:
+                seen = jax.ops.segment_max(
+                    valid.astype(jnp.int32), seg, num_segments=G + 1,
+                    indices_are_sorted=True,
+                )[:G]
+                va = jnp.logical_and(group_valid, seen > 0)
+            else:
+                va = group_valid
+        results.append(jnp.where(va, r, jnp.zeros((), r.dtype)))
+        valid_results.append(va)
 
-    return GroupedResult(rep_indices, group_valid, num_groups, results)
+    return GroupedResult(rep_indices, group_valid, num_groups, results,
+                         valid_results)
 
 
 def _max_ident(dt):
@@ -183,22 +190,53 @@ def _min_ident(dt):
 # ---------------------------------------------------------------------------
 
 
-def scalar_aggregate(live: jax.Array, aggs: Sequence[AggInput]) -> List[jax.Array]:
+def scalar_aggregate(
+    live: jax.Array, aggs: Sequence[AggInput]
+) -> Tuple[List[jax.Array], List[jax.Array]]:
+    """Returns (values, validities) — validity False when no valid input."""
     out: List[jax.Array] = []
+    valid_out: List[jax.Array] = []
     for a in aggs:
         valid = live
         if a.validity is not None:
             valid = jnp.logical_and(valid, a.validity)
+        any_valid = jnp.any(valid)
         if a.op == "count":
             out.append(jnp.sum(valid.astype(jnp.int64)))
+            valid_out.append(jnp.ones((), jnp.bool_))
             continue
         v = a.values
         if a.op == "sum":
-            out.append(jnp.sum(jnp.where(valid, v, jnp.zeros((), v.dtype))))
+            r = jnp.sum(jnp.where(valid, v, jnp.zeros((), v.dtype)))
         elif a.op == "min":
-            out.append(jnp.min(jnp.where(valid, v, _max_ident(v.dtype))))
+            r = jnp.min(jnp.where(valid, v, _max_ident(v.dtype)))
         elif a.op == "max":
-            out.append(jnp.max(jnp.where(valid, v, _min_ident(v.dtype))))
+            r = jnp.max(jnp.where(valid, v, _min_ident(v.dtype)))
         else:
             raise ExecutionError(f"unknown aggregate op {a.op}")
-    return out
+        out.append(jnp.where(any_valid, r, jnp.zeros((), r.dtype)))
+        valid_out.append(any_valid)
+    return out, valid_out
+
+
+# ---------------------------------------------------------------------------
+# Exact fixed-point average: sum/count scaled to 10^6 without overflowing
+# ---------------------------------------------------------------------------
+
+
+def avg_fixed(sum_: jax.Array, count: jax.Array, in_scale: int) -> jax.Array:
+    """(sum / count) scaled to Decimal(6), overflow-safe.
+
+    Splits the division: A = q*M + (r*M)//count with q=sum//count,
+    r=sum%count, M=10^(6-in_scale) — r*M stays < count*M so the only
+    overflow left is a logical |avg| >= ~9.2e12, documented out of range.
+    """
+    s = sum_.astype(jnp.int64)
+    if in_scale > 6:
+        s = jax.lax.div(s, jnp.int64(10 ** (in_scale - 6)))
+        in_scale = 6
+    m = jnp.int64(10 ** (6 - in_scale))
+    c = jnp.maximum(count.astype(jnp.int64), 1)
+    q = jax.lax.div(s, c)
+    r = jax.lax.rem(s, c)
+    return q * m + jax.lax.div(r * m, c)

@@ -12,7 +12,7 @@ build side is *sorted* and the probe side does a vectorized binary search
 - ``probe_expand`` (general many-to-many) computes per-probe match counts
   and materializes matches up to a static output capacity.
 
-Keys are int64 composites (see kernels.aggregate.pack_keys).
+Keys are single int64 columns (dict codes / ints / dates cast to int64).
 """
 
 from __future__ import annotations
@@ -33,6 +33,11 @@ class BuildTable:
     sorted_keys: jax.Array  # int64 [Nb] (dead rows = sentinel, at end)
     order: jax.Array  # int32 [Nb] original row index per sorted slot
     num_live: jax.Array  # int32 scalar
+
+
+jax.tree_util.register_dataclass(
+    BuildTable, data_fields=["sorted_keys", "order", "num_live"], meta_fields=[]
+)
 
 
 def build_lookup(keys: jax.Array, live: jax.Array) -> BuildTable:

@@ -1,0 +1,168 @@
+"""Logical plan optimizer.
+
+The reference delegates optimization to DataFusion's optimizer before
+distributed planning (reference: rust/scheduler/src/lib.rs:317-331 calls
+``ctx.optimize``); for a TPU engine the two rules that matter most are
+implemented natively:
+
+- **filter pushdown**: WHERE conjuncts sink below joins to the side whose
+  columns they reference (cuts probe/build sizes before any device work);
+- **projection pruning**: table scans read only referenced columns (string
+  columns that are never touched skip dictionary building entirely).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Set
+
+from . import expr as ex
+from .errors import PlanError
+from .logical import (
+    Aggregate,
+    EmptyRelation,
+    Explain,
+    Filter,
+    Join,
+    Limit,
+    LogicalPlan,
+    Projection,
+    Repartition,
+    Sort,
+    TableScan,
+)
+
+
+def optimize(plan: LogicalPlan) -> LogicalPlan:
+    plan = push_filters(plan)
+    plan = prune_columns(plan, None)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# Filter pushdown
+# ---------------------------------------------------------------------------
+
+
+def split_conjuncts(e: ex.Expr) -> List[ex.Expr]:
+    if isinstance(e, ex.BinaryExpr) and e.op == "and":
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
+def conjoin(parts: List[ex.Expr]) -> ex.Expr:
+    out = parts[0]
+    for p in parts[1:]:
+        out = ex.BinaryExpr(out, "and", p)
+    return out
+
+
+def push_filters(plan: LogicalPlan) -> LogicalPlan:
+    if isinstance(plan, Filter):
+        child = push_filters(plan.input)
+        conjuncts = split_conjuncts(plan.predicate)
+        return _sink(conjuncts, child)
+    if isinstance(plan, Projection):
+        return Projection(plan.exprs, push_filters(plan.input))
+    if isinstance(plan, Aggregate):
+        return Aggregate(plan.group_exprs, plan.agg_exprs, push_filters(plan.input))
+    if isinstance(plan, Sort):
+        return Sort(plan.sort_exprs, push_filters(plan.input))
+    if isinstance(plan, Limit):
+        return Limit(plan.n, push_filters(plan.input))
+    if isinstance(plan, Repartition):
+        return Repartition(push_filters(plan.input), plan.num_partitions,
+                           plan.hash_exprs)
+    if isinstance(plan, Join):
+        return Join(push_filters(plan.left), push_filters(plan.right),
+                    plan.on, plan.how)
+    if isinstance(plan, Explain):
+        return Explain(push_filters(plan.input), plan.verbose)
+    return plan
+
+
+def _sink(conjuncts: List[ex.Expr], node: LogicalPlan) -> LogicalPlan:
+    """Place each conjunct as low as possible over ``node``."""
+    if isinstance(node, Join) and node.how == "inner":
+        lcols = set(node.left.schema().names())
+        rcols = set(node.right.schema().names())
+        left_preds, right_preds, keep = [], [], []
+        for c in conjuncts:
+            refs = set(ex.referenced_columns(c))
+            if refs and refs <= lcols:
+                left_preds.append(c)
+            elif refs and refs <= rcols:
+                right_preds.append(c)
+            else:
+                keep.append(c)
+        left = _sink(left_preds, node.left) if left_preds else node.left
+        right = _sink(right_preds, node.right) if right_preds else node.right
+        out: LogicalPlan = Join(left, right, node.on, node.how)
+        if keep:
+            out = Filter(conjoin(keep), out)
+        return out
+    if isinstance(node, Filter):
+        # merge adjacent filters, keep sinking
+        return _sink(conjuncts + split_conjuncts(node.predicate), node.input)
+    if not conjuncts:
+        return node
+    return Filter(conjoin(conjuncts), node)
+
+
+# ---------------------------------------------------------------------------
+# Projection pruning
+# ---------------------------------------------------------------------------
+
+
+def _cols_of(exprs) -> Set[str]:
+    out: Set[str] = set()
+    for e in exprs:
+        out.update(ex.referenced_columns(e))
+    return out
+
+
+def prune_columns(plan: LogicalPlan, required: Optional[Set[str]]) -> LogicalPlan:
+    """required=None means every column of this node's schema is needed."""
+    if isinstance(plan, TableScan):
+        if required is None:
+            return plan
+        schema = plan.source.table_schema()
+        names = [n for n in schema.names() if n in required]
+        if not names:  # degenerate count(*)-style scan: keep first column
+            names = [schema.names()[0]]
+        return TableScan(plan.table_name, plan.source, tuple(names))
+    if isinstance(plan, Projection):
+        need = _cols_of(plan.exprs)
+        return Projection(plan.exprs, prune_columns(plan.input, need))
+    if isinstance(plan, Filter):
+        need = None if required is None else set(required) | _cols_of([plan.predicate])
+        return Filter(plan.predicate, prune_columns(plan.input, need))
+    if isinstance(plan, Aggregate):
+        need = _cols_of(plan.group_exprs) | _cols_of(plan.agg_exprs)
+        return Aggregate(plan.group_exprs, plan.agg_exprs,
+                         prune_columns(plan.input, need))
+    if isinstance(plan, Sort):
+        need = None if required is None else set(required) | _cols_of(plan.sort_exprs)
+        return Sort(plan.sort_exprs, prune_columns(plan.input, need))
+    if isinstance(plan, Limit):
+        return Limit(plan.n, prune_columns(plan.input, required))
+    if isinstance(plan, Repartition):
+        need = required
+        if plan.hash_exprs and required is not None:
+            need = set(required) | _cols_of(plan.hash_exprs)
+        return Repartition(prune_columns(plan.input, need),
+                           plan.num_partitions, plan.hash_exprs)
+    if isinstance(plan, Join):
+        lnames = set(plan.left.schema().names())
+        rnames = set(plan.right.schema().names())
+        on_l = {l for l, _ in plan.on}
+        on_r = {r for _, r in plan.on}
+        if required is None:
+            lneed, rneed = None, None
+        else:
+            lneed = (set(required) & lnames) | on_l
+            rneed = (set(required) & rnames) | on_r
+        return Join(prune_columns(plan.left, lneed),
+                    prune_columns(plan.right, rneed), plan.on, plan.how)
+    if isinstance(plan, Explain):
+        return Explain(prune_columns(plan.input, None), plan.verbose)
+    return plan

@@ -1,0 +1,10 @@
+"""Physical plan layer: executable operators over ColumnBatches.
+
+TPU-native equivalents of the reference's 15 ``PhysicalPlanNode`` operator
+variants (reference: rust/core/proto/ballista.proto:294-312): scan, filter,
+projection, hash-aggregate (partial/final), sort, limits, merge, join,
+repartition, plus the distributed shuffle trio (query-stage, shuffle-reader,
+unresolved-shuffle) in ``shuffle.py``.
+"""
+
+from .base import PhysicalPlan, PipelineOp, Partitioning  # noqa: F401

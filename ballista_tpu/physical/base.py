@@ -1,0 +1,161 @@
+"""Physical plan base classes + batch utilities.
+
+Execution model: ``execute(partition)`` yields ColumnBatches (host-driven
+volcano at batch granularity), but *pipeline* operators (filter/projection/
+partial-agg input chains) are traced together and jitted, so a whole chain
+runs as ONE fused XLA program per batch — the TPU-native answer to the
+reference's per-operator Rust volcano streams (reference:
+rust/core/src/execution_plans/query_stage.rs:29-85 executes DataFusion
+streams operator-by-operator).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, ColumnBatch
+from ..datatypes import Schema
+from ..errors import ExecutionError
+
+
+@dataclass(frozen=True)
+class Partitioning:
+    """Output partitioning descriptor."""
+
+    kind: str  # "unknown" | "round_robin" | "hash"
+    num_partitions: int
+    hash_columns: tuple = ()
+
+
+class PhysicalPlan:
+    """Base physical operator."""
+
+    def output_schema(self) -> Schema:
+        raise NotImplementedError
+
+    def output_partitioning(self) -> Partitioning:
+        cs = self.children()
+        if cs:
+            return cs[0].output_partitioning()
+        return Partitioning("unknown", 1)
+
+    def children(self) -> List["PhysicalPlan"]:
+        return []
+
+    def with_new_children(self, children: List["PhysicalPlan"]) -> "PhysicalPlan":
+        raise NotImplementedError(type(self).__name__)
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        raise NotImplementedError(type(self).__name__)
+
+    def display(self) -> str:
+        return type(self).__name__
+
+    def pretty(self, indent: int = 0) -> str:
+        out = "  " * indent + self.display() + "\n"
+        for c in self.children():
+            out += c.pretty(indent + 1)
+        return out
+
+
+class PipelineOp(PhysicalPlan):
+    """Operator whose work is a pure batch->batch device transform.
+
+    Chains of PipelineOps are fused into one jitted function; the chain's
+    non-pipeline root feeds batches through it.
+    """
+
+    child: PhysicalPlan
+
+    def device_transform(self, batch: ColumnBatch) -> ColumnBatch:
+        raise NotImplementedError(type(self).__name__)
+
+    def children(self) -> List[PhysicalPlan]:
+        return [self.child]
+
+    # fused execution ------------------------------------------------------
+
+    def _pipeline_chain(self):
+        """(transforms outer-to-inner reversed into apply order, source op)."""
+        chain: List[PipelineOp] = []
+        node: PhysicalPlan = self
+        while isinstance(node, PipelineOp):
+            chain.append(node)
+            node = node.child
+        chain.reverse()  # innermost transform first
+        return chain, node
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        chain, source = self._pipeline_chain()
+        fused = getattr(self, "_fused_fn", None)
+        if fused is None:
+
+            def apply_all(batch):
+                for op in chain:
+                    batch = op.device_transform(batch)
+                return batch
+
+            fused = jax.jit(apply_all)
+            self._fused_fn = fused
+        for batch in source.execute(partition):
+            yield fused(batch)
+
+
+# ---------------------------------------------------------------------------
+# Batch utilities shared by operators
+# ---------------------------------------------------------------------------
+
+
+def concat_batches(schema: Schema, batches: List[ColumnBatch]) -> ColumnBatch:
+    """Concatenate batches (device) into one larger-capacity batch."""
+    if not batches:
+        raise ExecutionError("concat of zero batches")
+    if len(batches) == 1:
+        return batches[0]
+    cols: List[Column] = []
+    for i, f in enumerate(schema.fields):
+        vals = jnp.concatenate([b.columns[i].values for b in batches])
+        vs = [b.columns[i].validity for b in batches]
+        if any(v is not None for v in vs):
+            validity = jnp.concatenate(
+                [
+                    v if v is not None else jnp.ones((b.capacity,), jnp.bool_)
+                    for v, b in zip(vs, batches)
+                ]
+            )
+        else:
+            validity = None
+        dict_ = next(
+            (b.columns[i].dictionary for b in batches if b.columns[i].dictionary),
+            None,
+        )
+        # all batches of a stream must share the interned table dictionary
+        for b in batches:
+            d = b.columns[i].dictionary
+            if d is not None and dict_ is not None and d is not dict_:
+                raise ExecutionError(
+                    f"cannot concat {f.name}: differing dictionaries"
+                )
+        cols.append(Column(vals, f.dtype, validity, dict_))
+    selection = jnp.concatenate([b.selection for b in batches])
+    num_rows = sum([b.num_rows for b in batches])
+    return ColumnBatch(schema, cols, selection, num_rows)
+
+
+def take_batch(batch: ColumnBatch, perm: jax.Array, live: jax.Array) -> ColumnBatch:
+    """Reorder a batch by ``perm``; ``live`` is the selection after reorder."""
+    cols = []
+    for col in batch.columns:
+        vals = jnp.take(col.values, perm, axis=0)
+        validity = (
+            jnp.take(col.validity, perm, axis=0) if col.validity is not None else None
+        )
+        cols.append(Column(vals, col.dtype, validity, col.dictionary))
+    return ColumnBatch(
+        batch.schema, cols, live, jnp.sum(live).astype(jnp.int32)
+    )

@@ -1,0 +1,210 @@
+"""Join physical operator.
+
+TPU-native equivalent of the reference's ``HashJoinExec`` (reference:
+rust/core/proto/ballista.proto:399-407; the distributed planner passes join
+children through without a co-partition stage, rust/scheduler/src/
+planner.rs:172-173 — we do the same in round 1, with the build side merged
+to a single partition).
+
+The build (left) side is materialized once and sorted (kernels.join);
+probe-side batches stream through a jitted probe that appends gathered
+build columns. FK->PK joins (unique build keys) take the no-expansion fast
+path; duplicate build keys fall back to the expanding probe with adaptive
+output capacity.
+
+Join types: inner, left (preserves PROBE side — the planner picks which
+logical side becomes the probe accordingly), semi, anti.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, ColumnBatch, round_capacity
+from ..datatypes import Schema
+from ..errors import ExecutionError, NotImplementedError_
+from ..kernels import join as join_k
+from .base import PhysicalPlan, Partitioning, concat_batches
+
+JOIN_TYPES = ("inner", "left", "semi", "anti")
+
+
+class JoinExec(PhysicalPlan):
+    """build = left child (merged to 1 partition), probe = right child."""
+
+    def __init__(
+        self,
+        build: PhysicalPlan,
+        probe: PhysicalPlan,
+        on: List[Tuple[str, str]],  # (build_col, probe_col)
+        how: str = "inner",
+    ):
+        if how not in JOIN_TYPES:
+            raise NotImplementedError_(f"join type {how}")
+        if len(on) != 1:
+            raise NotImplementedError_("multi-column join keys (round 2)")
+        self.build = build
+        self.probe = probe
+        self.on = list(on)
+        self.how = how
+        self._build_data = None  # (BuildTable, build_batch, unique)
+        self._jit_probe = {}
+
+    # -- schema -------------------------------------------------------------
+
+    def output_schema(self) -> Schema:
+        bs, ps = self.build.output_schema(), self.probe.output_schema()
+        if self.how in ("semi", "anti"):
+            return ps
+        seen = {f.name for f in bs.fields}
+        extra = [f for f in ps.fields if f.name not in seen]
+        # build fields become nullable under probe-preserving (left) joins
+        bf = list(bs.fields)
+        return Schema(bf + extra)
+
+    def output_partitioning(self) -> Partitioning:
+        return self.probe.output_partitioning()
+
+    def children(self):
+        return [self.build, self.probe]
+
+    def with_new_children(self, children):
+        return JoinExec(children[0], children[1], self.on, self.how)
+
+    def display(self) -> str:
+        on = ", ".join(f"{l}={r}" for l, r in self.on)
+        return f"JoinExec: how={self.how} on=[{on}]"
+
+    # -- execution ----------------------------------------------------------
+
+    def _materialize_build(self):
+        if self._build_data is not None:
+            return self._build_data
+        nparts = self.build.output_partitioning().num_partitions
+        batches = []
+        for p in range(nparts):
+            batches.extend(self.build.execute(p))
+        if not batches:
+            raise ExecutionError("join build side produced no batches")
+        bb = concat_batches(self.build.output_schema(), batches)
+        bkey_col = bb.column(self.on[0][0])
+        keys = bkey_col.values.astype(jnp.int64)
+        live = bb.selection
+        if bkey_col.validity is not None:
+            live = jnp.logical_and(live, bkey_col.validity)
+        table = jax.jit(join_k.build_lookup)(keys, live)
+        sk = np.asarray(table.sorted_keys)
+        nlive = int(table.num_live)
+        unique = not bool(np.any(sk[1 : nlive] == sk[: nlive - 1])) if nlive > 1 else True
+        self._build_data = (table, bb, unique)
+        return self._build_data
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        table, build_batch, unique = self._materialize_build()
+        for pb in self.probe.execute(partition):
+            if unique:
+                yield self._probe_unique_batch(table, build_batch, pb)
+            else:
+                yield self._probe_expand_batch(table, build_batch, pb)
+
+    # fast path: unique build keys ------------------------------------------
+
+    def _probe_unique_batch(self, table, build_batch, pb: ColumnBatch) -> ColumnBatch:
+        key = ("u", pb.capacity, build_batch.capacity)
+        if key not in self._jit_probe:
+
+            def run(table, bb: ColumnBatch, pb: ColumnBatch) -> ColumnBatch:
+                pkey_col = pb.column(self.on[0][1])
+                pkeys = pkey_col.values.astype(jnp.int64)
+                plive = pb.selection
+                if pkey_col.validity is not None:
+                    plive = jnp.logical_and(plive, pkey_col.validity)
+                build_rows, matched = join_k.probe_unique(table, pkeys, plive)
+                return self._assemble(bb, pb, build_rows, matched,
+                                      pb.selection, None)
+
+            self._jit_probe[key] = jax.jit(run)
+        return self._jit_probe[key](table, build_batch, pb)
+
+    # general path: expanding probe -----------------------------------------
+
+    def _probe_expand_batch(self, table, build_batch, pb: ColumnBatch) -> ColumnBatch:
+        if self.how != "inner":
+            raise NotImplementedError_(
+                f"{self.how} join with duplicate build keys (round 2)"
+            )
+        out_cap = pb.capacity
+        while True:
+            key = ("e", pb.capacity, build_batch.capacity, out_cap)
+            if key not in self._jit_probe:
+
+                def run(table, bb, pb, _cap=out_cap):
+                    pkey_col = pb.column(self.on[0][1])
+                    pkeys = pkey_col.values.astype(jnp.int64)
+                    plive = pb.selection
+                    if pkey_col.validity is not None:
+                        plive = jnp.logical_and(plive, pkey_col.validity)
+                    prows, brows, olive, total = join_k.probe_expand(
+                        table, pkeys, plive, _cap
+                    )
+                    out = self._assemble_expanded(bb, pb, prows, brows, olive)
+                    return out, total
+
+                self._jit_probe[key] = jax.jit(run)
+            out, total = self._jit_probe[key](table, build_batch, pb)
+            t = int(total)
+            if t <= out_cap:
+                return out
+            out_cap = round_capacity(t)
+
+    # assembly --------------------------------------------------------------
+
+    def _assemble(self, bb, pb, build_rows, matched, probe_sel, _):
+        """Probe-aligned output (no expansion). Traced."""
+        schema = self.output_schema()
+        if self.how == "semi":
+            sel = jnp.logical_and(probe_sel, matched)
+            return pb.with_selection(sel)
+        if self.how == "anti":
+            sel = jnp.logical_and(probe_sel, jnp.logical_not(matched))
+            return pb.with_selection(sel)
+        if self.how == "inner":
+            sel = jnp.logical_and(probe_sel, matched)
+        else:  # left (probe-preserving outer)
+            sel = probe_sel
+        cols = []
+        ps = pb.schema
+        for f in schema.fields:
+            if ps.has_field(f.name):
+                c = pb.column(f.name)
+                cols.append(c)
+            else:
+                c = bb.column(f.name)
+                vals = jnp.take(c.values, build_rows)
+                validity = jnp.take(c.validity, build_rows) if c.validity is not None \
+                    else jnp.ones((pb.capacity,), jnp.bool_)
+                validity = jnp.logical_and(validity, matched)
+                cols.append(Column(vals, c.dtype, validity, c.dictionary))
+        return ColumnBatch(schema, cols, sel, jnp.sum(sel).astype(jnp.int32))
+
+    def _assemble_expanded(self, bb, pb, prows, brows, olive):
+        schema = self.output_schema()
+        cols = []
+        ps = pb.schema
+        for f in schema.fields:
+            if ps.has_field(f.name):
+                c = pb.column(f.name)
+                vals = jnp.take(c.values, prows)
+                validity = jnp.take(c.validity, prows) if c.validity is not None else None
+            else:
+                c = bb.column(f.name)
+                vals = jnp.take(c.values, brows)
+                validity = jnp.take(c.validity, brows) if c.validity is not None else None
+            cols.append(Column(vals, c.dtype, validity, c.dictionary))
+        return ColumnBatch(
+            schema, cols, olive, jnp.sum(olive).astype(jnp.int32)
+        )

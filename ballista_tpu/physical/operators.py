@@ -1,0 +1,338 @@
+"""Core physical operators: scan, filter, projection, merge, sort, limit,
+repartition.
+
+TPU-native equivalents of the reference's PhysicalPlanNode variants
+CsvScan/ParquetScan/Filter/Projection/Merge/Sort/GlobalLimit/LocalLimit/
+Repartition/CoalesceBatches (reference: rust/core/proto/ballista.proto:
+294-312). Filter and Projection are PipelineOps — they fuse with adjacent
+pipeline stages into a single XLA program (batches never round-trip to HBM
+between them).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, ColumnBatch
+from ..datatypes import Schema
+from ..errors import ExecutionError, NotImplementedError_
+from .. import expr as ex
+from ..kernels.expr_eval import Evaluator
+from ..kernels.sort import sort_permutation
+from ..kernels.hashing import splitmix64
+from ..logical import TableSource
+from .base import PhysicalPlan, PipelineOp, Partitioning, concat_batches, take_batch
+
+
+class ScanExec(PhysicalPlan):
+    """Table scan over a partitioned source (reference: CsvScanExecNode /
+    ParquetScanExecNode, ballista.proto:334-354)."""
+
+    def __init__(self, table_name: str, source: TableSource,
+                 projection: Optional[Sequence[str]] = None):
+        self.table_name = table_name
+        self.source = source
+        self.projection = tuple(projection) if projection is not None else None
+
+    def output_schema(self) -> Schema:
+        s = self.source.table_schema()
+        return s.project(self.projection) if self.projection else s
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning("unknown", self.source.num_partitions())
+
+    def with_new_children(self, children):
+        assert not children
+        return self
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        yield from self.source.scan(partition, self.projection)
+
+    def display(self) -> str:
+        p = f" projection={list(self.projection)}" if self.projection else ""
+        return f"ScanExec: {self.table_name}{p}"
+
+
+class FilterExec(PipelineOp):
+    def __init__(self, predicate: ex.Expr, child: PhysicalPlan):
+        self.predicate = predicate
+        self.child = child
+        self._ev = Evaluator(child.output_schema())
+
+    def output_schema(self) -> Schema:
+        return self.child.output_schema()
+
+    def with_new_children(self, children):
+        return FilterExec(self.predicate, children[0])
+
+    def device_transform(self, batch: ColumnBatch) -> ColumnBatch:
+        mask = self._ev.evaluate_predicate(self.predicate, batch)
+        sel = jnp.logical_and(batch.selection, mask)
+        return batch.with_selection(sel)
+
+    def display(self) -> str:
+        return f"FilterExec: {self.predicate.name()}"
+
+
+class ProjectionExec(PipelineOp):
+    def __init__(self, exprs: List[ex.Expr], child: PhysicalPlan):
+        self.exprs = list(exprs)
+        self.child = child
+        self._in_schema = child.output_schema()
+        self._ev = Evaluator(self._in_schema)
+        self._schema = Schema([e.to_field(self._in_schema) for e in self.exprs])
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def with_new_children(self, children):
+        return ProjectionExec(self.exprs, children[0])
+
+    def device_transform(self, batch: ColumnBatch) -> ColumnBatch:
+        cols = [self._ev.to_column(e, batch) for e in self.exprs]
+        # trust planned schema for dtypes (evaluator agrees by construction)
+        return batch.with_columns(self._schema, cols)
+
+    def display(self) -> str:
+        return f"ProjectionExec: {', '.join(e.name() for e in self.exprs)}"
+
+
+class MergeExec(PhysicalPlan):
+    """Gather all input partitions into one (reference: MergeExecNode,
+    ballista.proto:409-413; planner boundary at planner.rs:136-148)."""
+
+    def __init__(self, child: PhysicalPlan):
+        self.child = child
+
+    def output_schema(self) -> Schema:
+        return self.child.output_schema()
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning("unknown", 1)
+
+    def children(self):
+        return [self.child]
+
+    def with_new_children(self, children):
+        return MergeExec(children[0])
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        if partition != 0:
+            raise ExecutionError("MergeExec has a single output partition")
+        for p in range(self.child.output_partitioning().num_partitions):
+            yield from self.child.execute(p)
+
+    def display(self) -> str:
+        return "MergeExec"
+
+
+class CoalesceBatchesExec(PhysicalPlan):
+    """Concatenate a partition's batches into one device batch (reference:
+    CoalesceBatchesExecNode, ballista.proto:362-368 — there it re-chunks
+    small batches; here it feeds barrier ops one static-shape batch)."""
+
+    def __init__(self, child: PhysicalPlan):
+        self.child = child
+
+    def output_schema(self) -> Schema:
+        return self.child.output_schema()
+
+    def children(self):
+        return [self.child]
+
+    def with_new_children(self, children):
+        return CoalesceBatchesExec(children[0])
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        batches = list(self.child.execute(partition))
+        if not batches:
+            return
+        yield concat_batches(self.output_schema(), batches)
+
+    def display(self) -> str:
+        return "CoalesceBatchesExec"
+
+
+class SortExec(PhysicalPlan):
+    """Total sort of a single partition (reference: SortExecNode,
+    ballista.proto:424-431)."""
+
+    def __init__(self, sort_exprs: List[ex.SortExpr], child: PhysicalPlan):
+        self.sort_exprs = list(sort_exprs)
+        self.child = child
+        self._ev = Evaluator(child.output_schema())
+        self._jit_sort = None
+
+    def output_schema(self) -> Schema:
+        return self.child.output_schema()
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning("unknown", 1)
+
+    def children(self):
+        return [self.child]
+
+    def with_new_children(self, children):
+        return SortExec(self.sort_exprs, children[0])
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        batches = list(self.child.execute(partition))
+        if not batches:
+            return
+        batch = concat_batches(self.output_schema(), batches)
+        if self._jit_sort is None:
+
+            def do_sort(b: ColumnBatch) -> ColumnBatch:
+                keys = []
+                for se in self.sort_exprs:
+                    r = self._ev.evaluate(se.expr, b)
+                    v = jnp.broadcast_to(r.values, (b.capacity,))
+                    keys.append((v, se.ascending))
+                perm = sort_permutation(keys, b.selection)
+                live_sorted = jnp.take(b.selection, perm)
+                return take_batch(b, perm, live_sorted)
+
+            self._jit_sort = jax.jit(do_sort)
+        yield self._jit_sort(batch)
+
+    def display(self) -> str:
+        return f"SortExec: {', '.join(e.name() for e in self.sort_exprs)}"
+
+
+class LimitExec(PhysicalPlan):
+    """Take the first n live rows of a (single) partition (reference:
+    GlobalLimitExecNode/LocalLimitExecNode, ballista.proto:386-397)."""
+
+    def __init__(self, n: int, child: PhysicalPlan):
+        self.n = n
+        self.child = child
+        self._jit_limit = None
+
+    def output_schema(self) -> Schema:
+        return self.child.output_schema()
+
+    def children(self):
+        return [self.child]
+
+    def with_new_children(self, children):
+        return LimitExec(self.n, children[0])
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        remaining = self.n
+        if self._jit_limit is None:
+
+            def take_first(b: ColumnBatch, k) -> ColumnBatch:
+                rank = jnp.cumsum(b.selection.astype(jnp.int32)) - 1
+                sel = jnp.logical_and(b.selection, rank < k)
+                return b.with_selection(sel)
+
+            self._jit_limit = jax.jit(take_first)
+        for batch in self.child.execute(partition):
+            if remaining <= 0:
+                break
+            out = self._jit_limit(batch, jnp.int32(remaining))
+            remaining -= out.num_rows_host()
+            yield out
+
+    def display(self) -> str:
+        return f"LimitExec: {self.n}"
+
+
+class RepartitionExec(PhysicalPlan):
+    """Re-partition input into N output partitions by hash or round-robin
+    (reference: RepartitionExecNode, ballista.proto:415-422).
+
+    Single-process implementation: child partitions are materialized once and
+    each output partition applies a selection mask (pid == p) — no compaction
+    on device. The distributed path uses shuffle writes instead.
+    """
+
+    def __init__(self, child: PhysicalPlan, num_partitions: int,
+                 hash_exprs: Optional[List[ex.Expr]] = None):
+        self.child = child
+        self.num_partitions = num_partitions
+        self.hash_exprs = hash_exprs
+        self._ev = Evaluator(child.output_schema())
+        self._cache: Optional[List[ColumnBatch]] = None
+        self._jit_mask = None
+
+    def output_schema(self) -> Schema:
+        return self.child.output_schema()
+
+    def output_partitioning(self) -> Partitioning:
+        kind = "hash" if self.hash_exprs else "round_robin"
+        cols = tuple(e.name() for e in (self.hash_exprs or []))
+        return Partitioning(kind, self.num_partitions, cols)
+
+    def children(self):
+        return [self.child]
+
+    def with_new_children(self, children):
+        return RepartitionExec(children[0], self.num_partitions, self.hash_exprs)
+
+    def partition_ids(self, batch: ColumnBatch, row_offset: int) -> jax.Array:
+        """int32 partition id per row (traced)."""
+        if self.hash_exprs:
+            h = jnp.zeros((batch.capacity,), jnp.uint64)
+            for e in self.hash_exprs:
+                r = self._ev.evaluate(e, batch)
+                v = jnp.broadcast_to(r.values, (batch.capacity,))
+                h = splitmix64(h ^ splitmix64(v.astype(jnp.int64)))
+            return (h % jnp.uint64(self.num_partitions)).astype(jnp.int32)
+        idx = row_offset + jnp.arange(batch.capacity, dtype=jnp.int32)
+        return idx % self.num_partitions
+
+    def _materialize(self) -> List[ColumnBatch]:
+        if self._cache is None:
+            out = []
+            for p in range(self.child.output_partitioning().num_partitions):
+                out.extend(self.child.execute(p))
+            self._cache = out
+        return self._cache
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        if self._jit_mask is None:
+
+            def mask_for(b: ColumnBatch, offset, p) -> ColumnBatch:
+                pids = self.partition_ids(b, offset)
+                sel = jnp.logical_and(b.selection, pids == p)
+                return b.with_selection(sel)
+
+            self._jit_mask = jax.jit(mask_for)
+        offset = 0
+        for batch in self._materialize():
+            yield self._jit_mask(batch, jnp.int32(offset), jnp.int32(partition))
+            offset += batch.num_rows_host()
+
+    def display(self) -> str:
+        k = "hash" if self.hash_exprs else "round-robin"
+        return f"RepartitionExec: {k} into {self.num_partitions}"
+
+
+class EmptyExec(PhysicalPlan):
+    """Zero- or one-row empty relation (reference: EmptyExecNode,
+    ballista.proto:356-360)."""
+
+    def __init__(self, produce_one_row: bool = False):
+        self.produce_one_row = produce_one_row
+
+    def output_schema(self) -> Schema:
+        return Schema([])
+
+    def with_new_children(self, children):
+        return self
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        n = 1 if self.produce_one_row else 0
+        sel = np.zeros(8, dtype=bool)
+        sel[:n] = True
+        yield ColumnBatch(
+            Schema([]), [], jnp.asarray(sel), jnp.asarray(np.int32(n))
+        )
+
+    def display(self) -> str:
+        return "EmptyExec"

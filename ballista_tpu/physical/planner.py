@@ -1,0 +1,112 @@
+"""Physical planner: logical plan -> physical operator tree.
+
+The reference gets this from DataFusion's ``create_physical_plan``
+(reference: rust/scheduler/src/lib.rs:317-331). Ours maps each logical node
+to the TPU operators in this package, inserting the Partial->Merge->Final
+aggregate split and probe/build side selection for joins.
+"""
+
+from __future__ import annotations
+
+from ..errors import NotImplementedError_, PlanError
+from .. import expr as ex
+from ..logical import (
+    Aggregate,
+    EmptyRelation,
+    Explain,
+    Filter,
+    Join,
+    Limit,
+    LogicalPlan,
+    Projection,
+    Repartition,
+    Sort,
+    TableScan,
+)
+from .aggregate import HashAggregateExec
+from .base import PhysicalPlan
+from .join import JoinExec
+from .operators import (
+    EmptyExec,
+    FilterExec,
+    LimitExec,
+    MergeExec,
+    ProjectionExec,
+    RepartitionExec,
+    ScanExec,
+    SortExec,
+)
+
+
+def create_physical_plan(plan: LogicalPlan) -> PhysicalPlan:
+    if isinstance(plan, TableScan):
+        return ScanExec(plan.table_name, plan.source, plan.projection)
+
+    if isinstance(plan, Projection):
+        return ProjectionExec(plan.exprs, create_physical_plan(plan.input))
+
+    if isinstance(plan, Filter):
+        return FilterExec(plan.predicate, create_physical_plan(plan.input))
+
+    if isinstance(plan, Aggregate):
+        child = create_physical_plan(plan.input)
+        partial = HashAggregateExec("partial", plan.group_exprs, plan.agg_exprs, child)
+        merged: PhysicalPlan = partial
+        if partial.output_partitioning().num_partitions > 1:
+            merged = MergeExec(partial)
+        return HashAggregateExec("final", plan.group_exprs, plan.agg_exprs, merged)
+
+    if isinstance(plan, Sort):
+        child = create_physical_plan(plan.input)
+        if child.output_partitioning().num_partitions > 1:
+            child = MergeExec(child)
+        return SortExec(plan.sort_exprs, child)
+
+    if isinstance(plan, Limit):
+        child = create_physical_plan(plan.input)
+        if child.output_partitioning().num_partitions > 1:
+            child = MergeExec(child)
+        return LimitExec(plan.n, child)
+
+    if isinstance(plan, Repartition):
+        return RepartitionExec(
+            create_physical_plan(plan.input), plan.num_partitions, plan.hash_exprs
+        )
+
+    if isinstance(plan, Join):
+        left = create_physical_plan(plan.left)
+        right = create_physical_plan(plan.right)
+        # Probe side = the row-preserving side; build side is merged to one
+        # partition and sorted (see JoinExec docstring).
+        if plan.how == "inner":
+            build, probe, how = left, right, "inner"
+            on = list(plan.on)
+        elif plan.how == "left":
+            build, probe, how = right, left, "left"
+            on = [(r, l) for l, r in plan.on]
+        elif plan.how == "right":
+            build, probe, how = left, right, "left"
+            on = list(plan.on)
+        elif plan.how in ("semi", "anti"):
+            build, probe, how = right, left, plan.how
+            on = [(r, l) for l, r in plan.on]
+        else:
+            raise NotImplementedError_(f"join type {plan.how}")
+        if build.output_partitioning().num_partitions > 1:
+            build = MergeExec(build)
+        joined: PhysicalPlan = JoinExec(build, probe, on, how)
+        # restore logical column order if the physical (build-first) order
+        # differs (e.g. preserved-left joins probe the left side)
+        want = plan.schema().names()
+        got = joined.output_schema().names()
+        if want != got:
+            joined = ProjectionExec([ex.col(n) for n in want], joined)
+        return joined
+
+    if isinstance(plan, EmptyRelation):
+        return EmptyExec(plan.produce_one_row)
+
+    if isinstance(plan, Explain):
+        raise PlanError("Explain handled by the client layer")
+
+    raise NotImplementedError_(f"no physical plan for {type(plan).__name__}")

@@ -13,7 +13,6 @@ from ballista_tpu.kernels.expr_eval import Evaluator
 from ballista_tpu.kernels.aggregate import (
     AggInput,
     grouped_aggregate,
-    pack_keys,
     scalar_aggregate,
 )
 from ballista_tpu.kernels.sort import sort_permutation
@@ -113,7 +112,7 @@ def test_grouped_aggregate():
     live = jnp.asarray([1, 1, 1, 1, 1, 1, 0, 0], dtype=bool)
     vals = jnp.asarray([10, 20, 30, 40, 50, 60, 70, 80], dtype=jnp.int64)
     res = grouped_aggregate(
-        keys, live,
+        [keys], live,
         [AggInput("sum", vals, None), AggInput("count", None, None),
          AggInput("min", vals, None), AggInput("max", vals, None)],
         group_capacity=4,
@@ -131,23 +130,49 @@ def test_grouped_aggregate():
     np.testing.assert_array_equal(np.asarray(keys)[rep], [1, 2, 3])
 
 
-def test_pack_keys_lexicographic():
-    a = jnp.asarray([0, 0, 1, 1], dtype=jnp.int32)
-    b = jnp.asarray([1, 0, 1, 0], dtype=jnp.int32)
-    k = pack_keys([(a, 4), (b, 4)])
-    order = np.argsort(np.asarray(k))
-    np.testing.assert_array_equal(order, [1, 0, 3, 2])
+def test_multikey_null_groups():
+    # NULL keys form their own group; all-NULL aggregates go NULL
+    k1 = jnp.asarray([1, 1, 2, 2, 1], dtype=jnp.int64)
+    kv = jnp.asarray([True, True, False, False, True])
+    live = jnp.ones(5, dtype=bool)
+    vals = jnp.asarray([10, 20, 30, 40, 50], dtype=jnp.int64)
+    vv = jnp.asarray([True, True, False, False, True])
+    res = grouped_aggregate(
+        [k1], live,
+        [AggInput("sum", vals, vv), AggInput("min", vals, vv),
+         AggInput("count", None, vv)],
+        group_capacity=4, key_validities=[kv],
+    )
+    assert int(res.num_groups) == 2
+    sums = np.asarray(res.aggregates[0])[:2]
+    counts = np.asarray(res.aggregates[2])[:2]
+    avalid = np.asarray(res.agg_valid[0])[:2]
+    # NULL-key group sorts first (validity 0 < 1): all inputs NULL there
+    assert list(counts) == [0, 3]
+    assert list(avalid) == [False, True]
+    assert sums[1] == 80
 
 
 def test_scalar_aggregate():
     live = jnp.asarray([True, True, False, True])
     vals = jnp.asarray([5, 7, 100, 3], dtype=jnp.int64)
-    out = scalar_aggregate(
+    out, valids = scalar_aggregate(
         live,
         [AggInput("sum", vals, None), AggInput("count", None, None),
          AggInput("min", vals, None), AggInput("max", vals, None)],
     )
     assert [int(x) for x in out] == [15, 3, 3, 7]
+    assert all(bool(v) for v in valids)
+
+
+def test_avg_fixed_overflow_safe():
+    from ballista_tpu.kernels.aggregate import avg_fixed
+
+    s = jnp.asarray(8 * 1_700_000_000_000, dtype=jnp.int64)
+    c = jnp.asarray(8, dtype=jnp.int64)
+    assert int(avg_fixed(s, c, 0)) == 1_700_000_000_000 * 10**6
+    # decimal(2) input
+    assert int(avg_fixed(jnp.int64(707), jnp.int64(2), 2)) == 3_535_000
 
 
 def test_sort_permutation_multikey():

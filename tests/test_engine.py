@@ -1,0 +1,207 @@
+"""End-to-end single-process engine tests (logical plan -> results),
+checked against independent pandas computations."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ballista_tpu import (
+    schema, col, lit, sum_, avg, min_, max_, count,
+    Int32, Int64, Decimal, Utf8, Date32, Float64,
+)
+from ballista_tpu.expr import SortExpr
+from ballista_tpu.io import MemTableSource
+from ballista_tpu.logical import LogicalPlanBuilder
+from ballista_tpu.execution import collect
+
+
+RNG = np.random.default_rng(42)
+N = 5000
+
+
+@pytest.fixture(scope="module")
+def lineitem():
+    s = schema(
+        ("l_orderkey", Int64),
+        ("l_quantity", Decimal(2)),
+        ("l_extendedprice", Decimal(2)),
+        ("l_discount", Decimal(2)),
+        ("l_shipdate", Date32),
+        ("l_returnflag", Utf8),
+        ("l_linestatus", Utf8),
+    )
+    data = {
+        "l_orderkey": RNG.integers(1, 1000, N),
+        "l_quantity": RNG.integers(1, 51, N),
+        "l_extendedprice": RNG.integers(100, 100000, N) / 100,
+        "l_discount": RNG.integers(0, 11, N) / 100,
+        "l_shipdate": RNG.integers(9000, 10000, N),
+        "l_returnflag": RNG.choice(["A", "N", "R"], N),
+        "l_linestatus": RNG.choice(["F", "O"], N),
+    }
+    src = MemTableSource.from_pydict(s, data, num_partitions=3)
+    df = pd.DataFrame(data)
+    return src, df
+
+
+@pytest.fixture(scope="module")
+def orders():
+    s = schema(
+        ("o_orderkey", Int64),
+        ("o_custkey", Int64),
+        ("o_orderdate", Date32),
+    )
+    data = {
+        "o_orderkey": np.arange(1, 1000),
+        "o_custkey": RNG.integers(1, 100, 999),
+        "o_orderdate": RNG.integers(8900, 10100, 999),
+    }
+    return MemTableSource.from_pydict(s, data, num_partitions=2), pd.DataFrame(data)
+
+
+def test_q1_style(lineitem):
+    src, df = lineitem
+    plan = (
+        LogicalPlanBuilder.scan("lineitem", src)
+        .filter(col("l_shipdate") <= lit(9700))
+        .aggregate(
+            [col("l_returnflag"), col("l_linestatus")],
+            [
+                sum_(col("l_quantity")).alias("sum_qty"),
+                sum_(col("l_extendedprice") * (lit(1) - col("l_discount"))).alias("sum_disc"),
+                avg(col("l_quantity")).alias("avg_qty"),
+                count().alias("cnt"),
+            ],
+        )
+        .sort([SortExpr(col("l_returnflag")), SortExpr(col("l_linestatus"))])
+        .build()
+    )
+    got = collect(plan)
+
+    d = df[df.l_shipdate <= 9700]
+    exp = (
+        d.groupby(["l_returnflag", "l_linestatus"])
+        .apply(
+            lambda g: pd.Series({
+                "sum_qty": g.l_quantity.sum(),
+                "sum_disc": (g.l_extendedprice * (1 - g.l_discount)).sum(),
+                "avg_qty": g.l_quantity.mean(),
+                "cnt": len(g),
+            }),
+            include_groups=False,
+        )
+        .reset_index()
+        .sort_values(["l_returnflag", "l_linestatus"])
+        .reset_index(drop=True)
+    )
+    assert list(got.columns) == ["l_returnflag", "l_linestatus", "sum_qty",
+                                 "sum_disc", "avg_qty", "cnt"]
+    np.testing.assert_array_equal(got.l_returnflag, exp.l_returnflag)
+    np.testing.assert_array_equal(got.l_linestatus, exp.l_linestatus)
+    np.testing.assert_allclose(got.sum_qty, exp.sum_qty, rtol=0)
+    np.testing.assert_allclose(got.sum_disc, exp.sum_disc, rtol=1e-12)
+    np.testing.assert_allclose(got.avg_qty, exp.avg_qty, atol=1e-6)
+    np.testing.assert_array_equal(got.cnt, exp.cnt)
+
+
+def test_ungrouped_aggregate(lineitem):
+    src, df = lineitem
+    plan = (
+        LogicalPlanBuilder.scan("lineitem", src)
+        .filter((col("l_discount") >= lit(0.03)) & (col("l_quantity") < lit(24)))
+        .aggregate(
+            [],
+            [
+                sum_(col("l_extendedprice") * col("l_discount")).alias("revenue"),
+                count().alias("n"),
+                min_(col("l_quantity")).alias("minq"),
+                max_(col("l_quantity")).alias("maxq"),
+            ],
+        )
+        .build()
+    )
+    got = collect(plan)
+    d = df[(df.l_discount >= 0.03) & (df.l_quantity < 24)]
+    assert len(got) == 1
+    np.testing.assert_allclose(
+        got.revenue[0], (d.l_extendedprice * d.l_discount).sum(), rtol=1e-12
+    )
+    assert got.n[0] == len(d)
+    assert got.minq[0] == d.l_quantity.min()
+    assert got.maxq[0] == d.l_quantity.max()
+
+
+def test_join_fk(lineitem, orders):
+    lsrc, ldf = lineitem
+    osrc, odf = orders
+    plan = (
+        LogicalPlanBuilder.scan("orders", osrc)
+        .join(
+            LogicalPlanBuilder.scan("lineitem", lsrc),
+            on=[("o_orderkey", "l_orderkey")],
+        )
+        .filter(col("o_orderdate") < lit(9500))
+        .aggregate(
+            [col("o_custkey")],
+            [sum_(col("l_quantity")).alias("qty"), count().alias("n")],
+        )
+        .sort([SortExpr(col("o_custkey"))])
+        .build()
+    )
+    got = collect(plan)
+    j = ldf.merge(odf, left_on="l_orderkey", right_on="o_orderkey")
+    j = j[j.o_orderdate < 9500]
+    exp = (
+        j.groupby("o_custkey")
+        .agg(qty=("l_quantity", "sum"), n=("l_quantity", "size"))
+        .reset_index()
+        .sort_values("o_custkey")
+        .reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(got.o_custkey, exp.o_custkey)
+    np.testing.assert_allclose(got.qty, exp.qty, rtol=0)
+    np.testing.assert_array_equal(got.n, exp.n)
+
+
+def test_sort_limit_projection(lineitem):
+    src, df = lineitem
+    plan = (
+        LogicalPlanBuilder.scan("lineitem", src)
+        .project([col("l_orderkey"), col("l_extendedprice")])
+        .sort([SortExpr(col("l_extendedprice"), ascending=False),
+               SortExpr(col("l_orderkey"))])
+        .limit(10)
+        .build()
+    )
+    got = collect(plan)
+    exp = (
+        df[["l_orderkey", "l_extendedprice"]]
+        .sort_values(["l_extendedprice", "l_orderkey"], ascending=[False, True])
+        .head(10)
+        .reset_index(drop=True)
+    )
+    assert len(got) == 10
+    np.testing.assert_array_equal(got.l_orderkey, exp.l_orderkey)
+    np.testing.assert_allclose(got.l_extendedprice, exp.l_extendedprice)
+
+
+def test_semi_anti_join(lineitem, orders):
+    lsrc, ldf = lineitem
+    osrc, odf = orders
+    early = odf[odf.o_orderdate < 9000]
+    for how in ("semi", "anti"):
+        plan = (
+            LogicalPlanBuilder.scan("lineitem", lsrc)
+            .join(
+                LogicalPlanBuilder.scan("orders", osrc)
+                .filter(col("o_orderdate") < lit(9000)),
+                on=[("l_orderkey", "o_orderkey")],
+                how=how,
+            )
+            .aggregate([], [count().alias("n")])
+            .build()
+        )
+        got = collect(plan)
+        in_early = ldf.l_orderkey.isin(early.o_orderkey)
+        exp_n = int(in_early.sum()) if how == "semi" else int((~in_early).sum())
+        assert got.n[0] == exp_n, how
