@@ -1,0 +1,246 @@
+"""Client API: BallistaContext + DataFrame.
+
+Mirrors the reference's client crate surface (reference:
+rust/client/src/context.rs:75-144 ``BallistaContext`` with remote/
+read_csv/read_parquet/register_*/sql; :149-315 ``BallistaDataFrame`` verbs
+select/filter/aggregate/sort/limit/repartition/collect) and its Python
+bindings (reference: python/src/context.rs, python/src/dataframe.rs).
+
+Two modes:
+- ``standalone()``: plans and executes in-process (single host, one device);
+- ``remote(host, port)``: submits plans to a scheduler over gRPC and fetches
+  results from executors (distributed layer).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from .datatypes import Schema, dtype_from_name, schema as make_schema
+from .errors import BallistaError, PlanError
+from . import expr as ex
+from .io import CsvSource, MemTableSource, ParquetSource, TblSource
+from .logical import (
+    Aggregate,
+    Filter,
+    Join,
+    Limit,
+    LogicalPlan,
+    LogicalPlanBuilder,
+    Projection,
+    Repartition,
+    Sort,
+    TableScan,
+    TableSource,
+)
+from .sql.parser import CreateExternalTable, Query, parse_sql
+from .sql.planner import CatalogTable, SqlPlanner
+
+
+def _default_pk(schema: Schema) -> Optional[str]:
+    """TPC-H-style convention: a first column named *key is the primary key."""
+    names = schema.names()
+    if names and names[0].endswith("key"):
+        return names[0]
+    return None
+
+
+class BallistaContext:
+    """Entry point: table registration + SQL/DataFrame construction."""
+
+    def __init__(self, mode: str = "standalone", host: str = "localhost",
+                 port: int = 50050, settings: Optional[Dict[str, str]] = None):
+        self.mode = mode
+        self.host = host
+        self.port = port
+        self.settings = dict(settings or {})
+        self._catalog: Dict[str, CatalogTable] = {}
+
+    # -- constructors -------------------------------------------------------
+
+    @staticmethod
+    def standalone(**settings) -> "BallistaContext":
+        return BallistaContext("standalone", settings=settings or None)
+
+    @staticmethod
+    def remote(host: str, port: int = 50050, **settings) -> "BallistaContext":
+        return BallistaContext("remote", host, port, settings or None)
+
+    # -- registration (reference: context.rs:110-129) -----------------------
+
+    def register_source(self, name: str, source: TableSource,
+                        primary_key: Optional[str] = None) -> None:
+        pk = primary_key or _default_pk(source.table_schema())
+        self._catalog[name] = CatalogTable(name, source, pk)
+
+    def register_tbl(self, name: str, path: str, schema: Schema,
+                     primary_key: Optional[str] = None, **kw) -> None:
+        self.register_source(name, TblSource(path, schema, **kw), primary_key)
+
+    def register_csv(self, name: str, path: str, schema: Schema,
+                     has_header: bool = True,
+                     primary_key: Optional[str] = None, **kw) -> None:
+        self.register_source(
+            name, CsvSource(path, schema, has_header=has_header, **kw), primary_key
+        )
+
+    def register_parquet(self, name: str, path: str,
+                         schema: Optional[Schema] = None,
+                         primary_key: Optional[str] = None, **kw) -> None:
+        self.register_source(name, ParquetSource(path, schema, **kw), primary_key)
+
+    def register_memtable(self, name: str, schema: Schema, data: Dict,
+                          num_partitions: int = 1,
+                          primary_key: Optional[str] = None) -> None:
+        self.register_source(
+            name, MemTableSource.from_pydict(schema, data, num_partitions),
+            primary_key,
+        )
+
+    def deregister_table(self, name: str) -> None:
+        self._catalog.pop(name, None)
+
+    def tables(self) -> List[str]:
+        return sorted(self._catalog)
+
+    # -- reads (reference: context.rs:88-108) -------------------------------
+
+    def read_tbl(self, path: str, schema: Schema, **kw) -> "DataFrame":
+        src = TblSource(path, schema, **kw)
+        return DataFrame(self, TableScan("tbl:" + path, src))
+
+    def read_csv(self, path: str, schema: Schema, has_header: bool = True,
+                 **kw) -> "DataFrame":
+        src = CsvSource(path, schema, has_header=has_header, **kw)
+        return DataFrame(self, TableScan("csv:" + path, src))
+
+    def read_parquet(self, path: str, schema: Optional[Schema] = None,
+                     **kw) -> "DataFrame":
+        src = ParquetSource(path, schema, **kw)
+        return DataFrame(self, TableScan("parquet:" + path, src))
+
+    def table(self, name: str) -> "DataFrame":
+        if name not in self._catalog:
+            raise PlanError(f"unknown table {name!r}")
+        t = self._catalog[name]
+        return DataFrame(self, TableScan(t.name, t.source))
+
+    # -- SQL ----------------------------------------------------------------
+
+    def sql(self, query: str) -> "DataFrame":
+        stmt = parse_sql(query)
+        if isinstance(stmt, CreateExternalTable):
+            sch = make_schema(*[(n, t) for n, t in stmt.columns])
+            if stmt.stored_as in ("CSV",):
+                self.register_csv(stmt.name, stmt.location, sch,
+                                  has_header=stmt.has_header)
+            elif stmt.stored_as in ("TBL",):
+                self.register_tbl(stmt.name, stmt.location, sch)
+            elif stmt.stored_as in ("PARQUET",):
+                self.register_parquet(stmt.name, stmt.location, sch)
+            else:
+                raise PlanError(f"STORED AS {stmt.stored_as} unsupported")
+            return DataFrame(self, None)
+        planner = SqlPlanner(self._catalog)
+        return DataFrame(self, planner.plan(stmt))
+
+    # -- execution ----------------------------------------------------------
+
+    def _collect(self, plan: LogicalPlan):
+        if self.mode == "standalone":
+            from .execution import collect
+
+            return collect(plan)
+        from .distributed.client import remote_collect
+
+        return remote_collect(self.host, self.port, plan, self.settings)
+
+
+class DataFrame:
+    """Lazy relational frame over a logical plan (reference:
+    BallistaDataFrame, rust/client/src/context.rs:149-315)."""
+
+    def __init__(self, ctx: BallistaContext, plan: Optional[LogicalPlan]):
+        self.ctx = ctx
+        self._plan = plan
+
+    # -- plan access --------------------------------------------------------
+
+    @property
+    def plan(self) -> LogicalPlan:
+        if self._plan is None:
+            raise PlanError("this DataFrame carries no plan (DDL result)")
+        return self._plan
+
+    def schema(self) -> Schema:
+        return self.plan.schema()
+
+    def explain(self) -> str:
+        from .optimizer import optimize
+
+        return (
+            "== Logical plan ==\n" + self.plan.pretty()
+            + "== Optimized ==\n" + optimize(self.plan).pretty()
+        )
+
+    def logical_plan(self) -> LogicalPlan:
+        return self.plan
+
+    # -- verbs --------------------------------------------------------------
+
+    def _with(self, plan: LogicalPlan) -> "DataFrame":
+        return DataFrame(self.ctx, plan)
+
+    def select(self, *exprs: Union[ex.Expr, str]) -> "DataFrame":
+        es = [ex.col(e) if isinstance(e, str) else e for e in exprs]
+        return self._with(Projection(list(es), self.plan))
+
+    def select_columns(self, *names: str) -> "DataFrame":
+        return self.select(*names)
+
+    def filter(self, predicate: ex.Expr) -> "DataFrame":
+        return self._with(Filter(predicate, self.plan))
+
+    where = filter
+
+    def aggregate(self, group_by: Sequence[ex.Expr],
+                  aggs: Sequence[ex.Expr]) -> "DataFrame":
+        return self._with(Aggregate(list(group_by), list(aggs), self.plan))
+
+    def sort(self, *sort_exprs: ex.Expr) -> "DataFrame":
+        ses = [
+            e if isinstance(e, ex.SortExpr) else ex.SortExpr(e)
+            for e in sort_exprs
+        ]
+        return self._with(Sort(ses, self.plan))
+
+    def limit(self, n: int) -> "DataFrame":
+        return self._with(Limit(n, self.plan))
+
+    def join(self, right: "DataFrame", on: Sequence[Tuple[str, str]],
+             how: str = "inner") -> "DataFrame":
+        return self._with(Join(self.plan, right.plan, list(on), how))
+
+    def repartition(self, num_partitions: int,
+                    hash_exprs: Optional[Sequence[ex.Expr]] = None) -> "DataFrame":
+        return self._with(
+            Repartition(self.plan, num_partitions,
+                        list(hash_exprs) if hash_exprs else None)
+        )
+
+    # -- execution ----------------------------------------------------------
+
+    def collect(self):
+        """Execute and return a pandas DataFrame."""
+        return self.ctx._collect(self.plan)
+
+    def to_pandas(self):
+        return self.collect()
+
+    def count(self) -> int:
+        agg = Aggregate([], [ex.count().alias("__n")], self.plan)
+        out = self.ctx._collect(agg)
+        return int(out["__n"][0])
+
+    def show(self, n: int = 20) -> None:
+        print(self.limit(n).collect().to_string())

@@ -140,25 +140,13 @@ class Column:
             vals = vals[row_mask]
             if invalid is not None:
                 invalid = invalid[row_mask]
-        has_nulls = invalid is not None and bool(invalid.any())
-        if self.dtype.kind == "utf8":
-            if self.dictionary is None:
-                raise ExecutionError("utf8 column without dictionary")
-            out = self.dictionary.lookup(vals)
-            if has_nulls:
-                out[invalid] = None
-            return out
-        if self.dtype.kind == "decimal":
-            out = vals.astype(np.float64) / (10.0 ** self.dtype.scale)
-        elif self.dtype.is_floating:
-            out = vals.astype(np.float64)
-        elif has_nulls:
-            out = vals.astype(np.float64)
-        else:
-            return vals
-        if has_nulls:
-            out[invalid] = np.nan
-        return out
+        if self.dtype.kind == "utf8" and self.dictionary is None:
+            raise ExecutionError("utf8 column without dictionary")
+        return decode_physical_array(
+            vals, self.dtype.kind, self.dtype.scale,
+            self.dictionary.values if self.dictionary is not None else None,
+            invalid,
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -338,6 +326,48 @@ jax.tree_util.register_pytree_node(ColumnBatch, _flatten_batch, _unflatten_batch
 # ---------------------------------------------------------------------------
 # Host-side helpers
 # ---------------------------------------------------------------------------
+
+
+def decode_physical_array(
+    vals: np.ndarray,
+    kind: str,
+    scale: int = 0,
+    dictionary_values: Optional[np.ndarray] = None,
+    null_mask: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Physical array -> logical host values, applying SQL NULL conventions
+    (None for strings, NaT for dates, NaN for numerics — integers with
+    NULLs widen to float64). Shared by local collect and the distributed
+    result-fetch path, so the decode rules cannot drift."""
+    has_nulls = null_mask is not None and bool(np.asarray(null_mask).any())
+    if kind == "utf8":
+        if dictionary_values is None:
+            raise ExecutionError("utf8 decode requires a dictionary")
+        dv = np.asarray(dictionary_values, dtype=object)
+        codes = np.asarray(vals).astype(np.int64)
+        ok = (codes >= 0) & (codes < len(dv))
+        out = np.empty(len(codes), dtype=object)
+        out[ok] = dv[codes[ok]]
+        out[~ok] = None
+        if has_nulls:
+            out[null_mask] = None
+        return out
+    if kind == "date32":
+        out = np.asarray(vals).astype("datetime64[D]")
+        if has_nulls:
+            out[null_mask] = np.datetime64("NaT")
+        return out
+    if kind == "decimal":
+        out = np.asarray(vals).astype(np.float64) / (10.0 ** scale)
+    elif kind in ("float32", "float64"):
+        out = np.asarray(vals).astype(np.float64)
+    elif has_nulls:
+        out = np.asarray(vals).astype(np.float64)
+    else:
+        return np.asarray(vals)
+    if has_nulls:
+        out[null_mask] = np.nan
+    return out
 
 
 def concat_pydicts(parts: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:

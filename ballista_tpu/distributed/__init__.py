@@ -1,0 +1,10 @@
+"""Distributed runtime: scheduler (control plane), executor (data plane),
+cluster state, and shuffle.
+
+Architecture mirrors the reference cluster design (reference:
+docs/architecture.md:5-46): one or more schedulers turn submitted plans
+into stage DAGs whose partition-tasks are pulled by executors over gRPC;
+stage outputs are materialized and fetched between executors through a
+data-plane socket protocol, with an ICI ``all_to_all`` fast path when
+producer and consumer share a TPU mesh (ballista_tpu.parallel).
+"""

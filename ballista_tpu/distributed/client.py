@@ -1,0 +1,102 @@
+"""Remote client: submit plan, poll status, fetch results.
+
+(reference: rust/client/src/context.rs:161-239 BallistaDataFrame::collect —
+submit -> 100ms GetJobStatus poll -> Flight-fetch every result partition.)
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional
+
+from ..errors import ClusterError
+from ..proto import ballista_pb2 as pb
+from .. import serde
+from .dataplane import fetch_partition_bytes
+from .scheduler import SchedulerClient
+
+POLL_SECS = 0.1  # reference: 100ms, context.rs:183-201
+
+
+def submit_plan(host: str, port: int, logical_plan,
+                settings: Optional[Dict[str, str]] = None) -> str:
+    client = SchedulerClient(host, port)
+    try:
+        params = pb.ExecuteQueryParams()
+        params.logical_plan.CopyFrom(serde.plan_to_proto(logical_plan))
+        for k, v in (settings or {}).items():
+            params.settings[k] = v
+        return client.ExecuteQuery(params).job_id
+    finally:
+        client.close()
+
+
+def wait_for_job(host: str, port: int, job_id: str,
+                 timeout: float = 300.0) -> pb.GetJobStatusResult:
+    client = SchedulerClient(host, port)
+    try:
+        deadline = time.time() + timeout
+        while True:
+            result = client.GetJobStatus(pb.GetJobStatusParams(job_id=job_id))
+            which = result.status.WhichOneof("status")
+            if which == "completed":
+                return result
+            if which == "failed":
+                raise ClusterError(
+                    f"job {job_id} failed: {result.status.failed.error}"
+                )
+            if time.time() > deadline:
+                raise ClusterError(f"job {job_id} timed out")
+            time.sleep(POLL_SECS)
+    finally:
+        client.close()
+
+
+def remote_collect(host: str, port: int, logical_plan,
+                   settings: Optional[Dict[str, str]] = None,
+                   timeout: float = 300.0):
+    """Submit + poll + fetch -> pandas DataFrame."""
+    import numpy as np
+    import pandas as pd
+
+    from ..io import ipc
+    from ..columnar import concat_pydicts
+
+    job_id = submit_plan(host, port, logical_plan, settings)
+    result = wait_for_job(host, port, job_id, timeout)
+
+    schema = None
+    parts = []
+    locations = sorted(
+        result.status.completed.partition_location,
+        key=lambda l: l.partition_id.partition_id,
+    )
+    out_schema = None
+    frames = []
+    for loc in locations:
+        if loc.path and os.path.exists(loc.path):
+            raw = open(loc.path, "rb").read()
+        else:
+            raw = fetch_partition_bytes(
+                loc.executor_meta.host, loc.executor_meta.port,
+                loc.partition_id.job_id, loc.partition_id.stage_id,
+                loc.partition_id.partition_id,
+            )
+        names, arrays, nulls, dicts, kinds = ipc.read_partition_arrays(raw)
+        cols = {}
+        for name in names:
+            kind, scale = kinds.get(name, ("", 0))
+            from ..columnar import decode_physical_array
+
+            cols[name] = decode_physical_array(
+                arrays[name],
+                "utf8" if name in dicts else kind,
+                scale,
+                dicts.get(name),
+                nulls[name],
+            )
+        frames.append(pd.DataFrame(cols))
+    if not frames:
+        return pd.DataFrame()
+    return pd.concat(frames, ignore_index=True)

@@ -1,0 +1,119 @@
+"""Shuffle data plane: socket protocol for partition fetch.
+
+The role Arrow Flight ``do_get`` plays in the reference (reference:
+rust/executor/src/flight_service.rs:193-228 FetchPartition;
+rust/core/src/client.rs:123-169 fetch side). Wire format (also spoken by
+the native C++ server in ballista_tpu/native/shuffle_server.cpp):
+
+  request:  u32_be length | ballista_tpu.Action protobuf
+  response: u8 status (0=ok, 1=error) | u64_be length | payload
+            payload = Arrow IPC file bytes (ok) or utf-8 error message
+
+Python server threads serve from the executor work_dir; the C++ server is a
+drop-in replacement on the same protocol.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import struct
+import threading
+from typing import Optional
+
+from ..errors import IoError
+from ..proto import ballista_pb2 as pb
+
+
+def partition_path(work_dir: str, job_id: str, stage_id: int,
+                   partition_id: int) -> str:
+    # layout mirrors the reference's work_dir/{job}/{stage}/{part}/data.arrow
+    # (reference: flight_service.rs:104-126)
+    return os.path.join(work_dir, job_id, str(stage_id), str(partition_id),
+                        "data.arrow")
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise IoError("data plane connection closed early")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def fetch_partition_bytes(host: str, port: int, job_id: str, stage_id: int,
+                          partition_id: int, timeout: float = 60.0) -> bytes:
+    action = pb.Action()
+    action.fetch_partition.job_id = job_id
+    action.fetch_partition.stage_id = stage_id
+    action.fetch_partition.partition_id = partition_id
+    payload = action.SerializeToString()
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
+        status = _recv_exact(sock, 1)[0]
+        (length,) = struct.unpack(">Q", _recv_exact(sock, 8))
+        body = _recv_exact(sock, length)
+    if status != 0:
+        raise IoError(f"fetch failed: {body.decode(errors='replace')}")
+    return body
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            (length,) = struct.unpack(">I", _recv_exact(self.request, 4))
+            action = pb.Action()
+            action.ParseFromString(_recv_exact(self.request, length))
+            which = action.WhichOneof("action_type")
+            if which != "fetch_partition":
+                raise IoError(f"unsupported data-plane action {which}")
+            f = action.fetch_partition
+            path = partition_path(
+                self.server.work_dir, f.job_id, f.stage_id, f.partition_id
+            )
+            if not os.path.exists(path):
+                raise IoError(f"no such partition: {path}")
+            with open(path, "rb") as fh:
+                body = fh.read()
+            self.request.sendall(struct.pack(">BQ", 0, len(body)))
+            self.request.sendall(body)
+        except Exception as e:  # noqa: BLE001 - report to peer
+            msg = str(e).encode()
+            try:
+                self.request.sendall(struct.pack(">BQ", 1, len(msg)) + msg)
+            except OSError:
+                pass
+
+
+class DataPlaneServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host: str, port: int, work_dir: str):
+        super().__init__((host, port), _Handler)
+        self.work_dir = work_dir
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+
+def start_data_plane(host: str, port: int, work_dir: str) -> DataPlaneServer:
+    server = DataPlaneServer(host, port, work_dir)
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="ballista-data-plane")
+    t.start()
+    return server

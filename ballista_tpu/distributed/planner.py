@@ -1,0 +1,129 @@
+"""Distributed planner: physical plan -> stage DAG.
+
+Re-implements the reference's ``DistributedPlanner`` splitting rules
+(reference: rust/scheduler/src/planner.rs:96-198):
+
+- a ``MergeExec`` boundary turns its child into a new query stage and
+  replaces it with an ``UnresolvedShuffleExec``;
+- a final-mode ``HashAggregateExec``'s child (the partial side) becomes a
+  stage;
+- an output-partitioning change (RepartitionExec) becomes a stage whose
+  output is hash-partitioned at materialization time;
+- join children pass through (the build side's MergeExec already forms a
+  stage).
+
+Stage ids start at 1 (reference: planner.rs:201-204); the root plan becomes
+the final stage.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..errors import PlanError
+from ..physical.aggregate import HashAggregateExec
+from ..physical.base import PhysicalPlan
+from ..physical.join import JoinExec
+from ..physical.operators import MergeExec, RepartitionExec
+from ..physical.shuffle import (
+    QueryStageExec,
+    ShuffleReaderExec,
+    UnresolvedShuffleExec,
+)
+from .types import PartitionLocation
+
+
+class DistributedPlanner:
+    def __init__(self):
+        self._next_stage_id = 0
+
+    def _new_stage_id(self) -> int:
+        self._next_stage_id += 1
+        return self._next_stage_id
+
+    def plan_query_stages(
+        self, job_id: str, plan: PhysicalPlan
+    ) -> List[QueryStageExec]:
+        """Returns all stages; the last one is the final (root) stage."""
+        new_plan, stages = self._plan_internal(job_id, plan)
+        stages.append(QueryStageExec(job_id, self._new_stage_id(), new_plan))
+        return stages
+
+    def _plan_internal(
+        self, job_id: str, plan: PhysicalPlan
+    ) -> Tuple[PhysicalPlan, List[QueryStageExec]]:
+        stages: List[QueryStageExec] = []
+        children = plan.children()
+        if not children:
+            return plan, stages
+
+        new_children: List[PhysicalPlan] = []
+        for child in children:
+            c_plan, c_stages = self._plan_internal(job_id, child)
+            stages.extend(c_stages)
+            new_children.append(c_plan)
+
+        if isinstance(plan, MergeExec) or isinstance(plan, RepartitionExec):
+            # child becomes a stage; this node reads its shuffled output
+            child = new_children[0]
+            stage = QueryStageExec(job_id, self._new_stage_id(), child)
+            stages.append(stage)
+            unresolved = UnresolvedShuffleExec(
+                [stage.stage_id],
+                child.output_schema(),
+                child.output_partitioning().num_partitions
+                if isinstance(plan, MergeExec)
+                else plan.num_partitions,
+            )
+            if isinstance(plan, MergeExec):
+                return plan.with_new_children([unresolved]), stages
+            # Repartition's shuffle write happens in the producing stage;
+            # the consumer just reads the repartitioned outputs
+            return unresolved, stages
+
+        if isinstance(plan, HashAggregateExec) and plan.mode == "final":
+            child = new_children[0]
+            if not isinstance(child, UnresolvedShuffleExec):
+                stage = QueryStageExec(job_id, self._new_stage_id(), child)
+                stages.append(stage)
+                child = UnresolvedShuffleExec(
+                    [stage.stage_id],
+                    stage.output_schema(),
+                    stage.output_partitioning().num_partitions,
+                )
+            return plan.with_new_children([child]), stages
+
+        return plan.with_new_children(new_children), stages
+
+
+def find_unresolved_shuffles(plan: PhysicalPlan) -> List[UnresolvedShuffleExec]:
+    """(reference: state/mod.rs:372-385)"""
+    out = []
+    if isinstance(plan, UnresolvedShuffleExec):
+        out.append(plan)
+    for c in plan.children():
+        out.extend(find_unresolved_shuffles(c))
+    return out
+
+
+def remove_unresolved_shuffles(
+    plan: PhysicalPlan,
+    locations: Dict[int, List[PartitionLocation]],  # stage_id -> locations
+) -> PhysicalPlan:
+    """Substitute resolved ShuffleReaderExecs (reference:
+    planner.rs:236-269)."""
+    if isinstance(plan, UnresolvedShuffleExec):
+        locs: List[PartitionLocation] = []
+        for sid in plan.query_stage_ids:
+            if sid not in locations:
+                raise PlanError(f"no locations for stage {sid}")
+            locs.extend(
+                sorted(locations[sid], key=lambda l: l.partition_id)
+            )
+        return ShuffleReaderExec(locs, plan.output_schema())
+    children = plan.children()
+    if not children:
+        return plan
+    return plan.with_new_children(
+        [remove_unresolved_shuffles(c, locations) for c in children]
+    )

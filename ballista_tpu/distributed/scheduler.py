@@ -1,0 +1,275 @@
+"""Scheduler: control-plane gRPC service + planning pipeline.
+
+Re-implements the reference scheduler (reference: rust/scheduler/src/
+lib.rs — the 5 SchedulerGrpc RPCs; execute_query background planning at
+:224-407, poll_work assignment at :105-182). Differences by design:
+
+- task assignment pops an event-driven ready-queue (see state.py) instead
+  of scanning all tasks under a global lock;
+- executors run tasks in-process (no self-RPC hop; the reference itself
+  flags its own as convoluted, execution_loop.rs:90-91).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import string
+import threading
+import time
+from concurrent import futures
+from typing import Dict, Optional
+
+import grpc
+
+from ..errors import ClusterError
+from ..execution import plan_logical
+from ..proto import ballista_pb2 as pb
+from .. import serde
+from .planner import (
+    DistributedPlanner,
+    find_unresolved_shuffles,
+    remove_unresolved_shuffles,
+)
+from .state import SchedulerState
+from .types import ExecutorMeta, JobStatus, PartitionId, TaskStatus
+
+log = logging.getLogger("ballista.scheduler")
+
+SERVICE = "ballista_tpu.SchedulerGrpc"
+
+
+def _job_id() -> str:
+    # 7-char alphanumeric starting with a letter (reference: lib.rs:262-270)
+    first = random.choice(string.ascii_lowercase)
+    rest = "".join(random.choices(string.ascii_lowercase + string.digits, k=6))
+    return first + rest
+
+
+class SchedulerService:
+    def __init__(self, state: SchedulerState):
+        self.state = state
+
+    # -- RPC: ExecuteQuery --------------------------------------------------
+
+    def ExecuteQuery(self, request: pb.ExecuteQueryParams, context=None):
+        if request.WhichOneof("query") == "logical_plan":
+            plan = serde.plan_from_proto(request.logical_plan)
+        else:
+            raise ClusterError(
+                "raw SQL submission requires client-side planning (tables "
+                "are registered in the client catalog)"
+            )
+        job_id = _job_id()
+        self.state.save_job_status(job_id, JobStatus("queued"))
+        t = threading.Thread(
+            target=self._plan_job, args=(job_id, plan), daemon=True,
+            name=f"plan-{job_id}",
+        )
+        t.start()
+        return pb.ExecuteQueryResult(job_id=job_id)
+
+    def _plan_job(self, job_id: str, logical_plan):
+        try:
+            t0 = time.time()
+            phys = plan_logical(logical_plan)
+            stages = DistributedPlanner().plan_query_stages(job_id, phys)
+            for stage in stages:
+                deps = [
+                    sid
+                    for u in find_unresolved_shuffles(stage.child)
+                    for sid in u.query_stage_ids
+                ]
+                nparts = stage.output_partitioning().num_partitions
+                plan_bytes = serde.physical_to_proto(stage.child).SerializeToString()
+                self.state.save_stage_plan(
+                    job_id, stage.stage_id, plan_bytes, nparts, deps
+                )
+                for p in range(nparts):
+                    self.state.save_task_status(
+                        TaskStatus(PartitionId(job_id, stage.stage_id, p))
+                    )
+            self.state.enqueue_job(job_id)
+            log.info(
+                "planned job %s into %d stages in %.0fms",
+                job_id, len(stages), 1000 * (time.time() - t0),
+            )
+        except Exception as e:  # noqa: BLE001 - job-level failure
+            log.exception("planning failed for job %s", job_id)
+            self.state.save_job_status(job_id, JobStatus("failed", error=str(e)))
+
+    # -- RPC: PollWork ------------------------------------------------------
+
+    def PollWork(self, request: pb.PollWorkParams, context=None):
+        meta = ExecutorMeta(
+            id=request.metadata.id,
+            host=request.metadata.host,
+            port=request.metadata.port,
+            num_devices=request.metadata.num_devices or 1,
+        )
+        self.state.save_executor_metadata(meta)
+        jobs_touched = set()
+        for ts in request.task_status:
+            st = _task_status_from_proto(ts)
+            jobs_touched.add(st.partition.job_id)
+            if st.state == "completed":
+                self.state.task_completed(st)
+            else:
+                self.state.save_task_status(st)
+        result = pb.PollWorkResult()
+        if request.can_accept_task:
+            task = self.state.next_task()
+            if task is not None:
+                try:
+                    result.task.CopyFrom(self._task_definition(task, meta))
+                except Exception as e:  # noqa: BLE001
+                    log.exception("task resolution failed for %s", task)
+                    self.state.save_task_status(
+                        TaskStatus(task, "failed", error=str(e))
+                    )
+                    jobs_touched.add(task.job_id)
+        for job_id in jobs_touched:
+            self.state.synchronize_job_status(job_id)
+        return result
+
+    def _task_definition(self, task: PartitionId, meta: ExecutorMeta
+                         ) -> pb.TaskDefinition:
+        plan_bytes, _, deps = self.state.get_stage_plan(task.job_id, task.stage_id)
+        node = pb.PhysicalPlanNode()
+        node.ParseFromString(plan_bytes)
+        plan = serde.physical_from_proto(node)
+        if deps:
+            locations = self.state.stage_locations(task.job_id)
+            plan = remove_unresolved_shuffles(plan, locations)
+        self.state.save_task_status(
+            TaskStatus(task, "running", executor_id=meta.id)
+        )
+        td = pb.TaskDefinition()
+        td.task_id.job_id = task.job_id
+        td.task_id.stage_id = task.stage_id
+        td.task_id.partition_id = task.partition_id
+        td.plan.CopyFrom(serde.physical_to_proto(plan))
+        return td
+
+    # -- RPC: GetJobStatus --------------------------------------------------
+
+    def GetJobStatus(self, request: pb.GetJobStatusParams, context=None):
+        st = self.state.get_job_status(request.job_id)
+        result = pb.GetJobStatusResult()
+        if st is None:
+            result.status.failed.error = f"unknown job {request.job_id}"
+        elif st.state == "queued":
+            result.status.queued.SetInParent()
+        elif st.state == "running":
+            result.status.running.SetInParent()
+        elif st.state == "failed":
+            result.status.failed.error = st.error or "unknown error"
+        else:
+            for loc in st.partition_locations or []:
+                result.status.completed.partition_location.append(
+                    serde.location_to_proto(loc)
+                )
+        return result
+
+    # -- RPC: GetExecutorsMetadata ------------------------------------------
+
+    def GetExecutorsMetadata(self, request, context=None):
+        result = pb.GetExecutorsMetadataResult()
+        for e in self.state.get_executors_metadata():
+            result.metadata.append(
+                pb.ExecutorMetadata(id=e.id, host=e.host, port=e.port,
+                                    num_devices=e.num_devices)
+            )
+        return result
+
+    # -- RPC: GetFileMetadata -----------------------------------------------
+
+    def GetFileMetadata(self, request: pb.GetFileMetadataParams, context=None):
+        from ..io import ParquetSource
+
+        if request.file_type.lower() not in ("parquet", ""):
+            raise ClusterError("only Parquet metadata is supported "
+                               "(reference parity: lib.rs:184-222)")
+        src = ParquetSource(request.path)
+        return pb.GetFileMetadataResult(
+            schema=serde.schema_to_proto(src.table_schema()),
+            num_partitions=src.num_partitions(),
+        )
+
+
+def _task_status_from_proto(ts: pb.TaskStatus) -> TaskStatus:
+    pid = PartitionId(ts.partition_id.job_id, ts.partition_id.stage_id,
+                      ts.partition_id.partition_id)
+    which = ts.WhichOneof("status")
+    if which == "running":
+        return TaskStatus(pid, "running", executor_id=ts.running.executor_id)
+    if which == "failed":
+        return TaskStatus(pid, "failed", error=ts.failed.error)
+    if which == "completed":
+        return TaskStatus(
+            pid, "completed", executor_id=ts.completed.executor_id,
+            path=ts.completed.path,
+            stats={
+                "num_rows": ts.completed.stats.num_rows,
+                "num_batches": ts.completed.stats.num_batches,
+                "num_bytes": ts.completed.stats.num_bytes,
+            },
+        )
+    return TaskStatus(pid)
+
+
+# ---------------------------------------------------------------------------
+# gRPC wiring (hand-rolled handlers; no grpc_tools codegen available)
+# ---------------------------------------------------------------------------
+
+_RPCS = {
+    "ExecuteQuery": (pb.ExecuteQueryParams, pb.ExecuteQueryResult),
+    "PollWork": (pb.PollWorkParams, pb.PollWorkResult),
+    "GetJobStatus": (pb.GetJobStatusParams, pb.GetJobStatusResult),
+    "GetExecutorsMetadata": (
+        pb.GetExecutorsMetadataParams, pb.GetExecutorsMetadataResult,
+    ),
+    "GetFileMetadata": (pb.GetFileMetadataParams, pb.GetFileMetadataResult),
+}
+
+
+def serve_scheduler(state: SchedulerState, host: str = "0.0.0.0",
+                    port: int = 50050, max_workers: int = 16):
+    """Start the scheduler gRPC server; returns (grpc_server, service)."""
+    svc = SchedulerService(state)
+    handlers = {}
+    for name, (req_t, _resp_t) in _RPCS.items():
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(svc, name),
+            request_deserializer=req_t.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+    )
+    bound = server.add_insecure_port(f"{host}:{port}")
+    server.start()
+    return server, svc, bound
+
+
+class SchedulerClient:
+    """Thin typed client over the generic gRPC channel."""
+
+    def __init__(self, host: str, port: int):
+        self.channel = grpc.insecure_channel(f"{host}:{port}")
+        self._stubs = {}
+        for name, (req_t, resp_t) in _RPCS.items():
+            self._stubs[name] = self.channel.unary_unary(
+                f"/{SERVICE}/{name}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=resp_t.FromString,
+            )
+
+    def __getattr__(self, name):
+        if name in _RPCS:
+            return self._stubs[name]
+        raise AttributeError(name)
+
+    def close(self):
+        self.channel.close()

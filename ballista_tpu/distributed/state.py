@@ -1,0 +1,343 @@
+"""Cluster state: KV backend abstraction + scheduler state machine.
+
+Re-implements the reference's scheduler state layer (reference:
+rust/scheduler/src/state/mod.rs — ``ConfigBackendClient`` KV trait at
+:46-59, key scheme /ballista/{ns}/... at :387-434, task assignment at
+:182-260, job-status synthesis at :267-358) with two backends:
+
+- ``MemoryBackend``: in-process dict (the reference's sled standalone);
+- ``SqliteBackend``: durable file-backed store (survives scheduler restart,
+  the role etcd/sled-on-disk plays for the reference).
+
+Improvement over the reference (its own TODO at state/mod.rs:263 "We should
+get rid of this to be able to scale"): task assignment keeps an explicit
+ready-queue of schedulable tasks instead of rescanning every task row under
+a global lock — stage-dependency checks run only when a stage completes.
+"""
+
+from __future__ import annotations
+
+import pickle
+import sqlite3
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..errors import ClusterError
+from .types import ExecutorMeta, JobStatus, PartitionId, PartitionLocation, TaskStatus
+
+EXECUTOR_LEASE_SECS = 60  # reference: LEASE_TIME, state/mod.rs:42
+
+
+# ---------------------------------------------------------------------------
+# KV backends
+# ---------------------------------------------------------------------------
+
+
+class KvBackend:
+    def get(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def get_from_prefix(self, prefix: str) -> List[Tuple[str, bytes]]:
+        raise NotImplementedError
+
+    def put(self, key: str, value: bytes, lease_secs: Optional[int] = None):
+        raise NotImplementedError
+
+    def delete(self, key: str):
+        raise NotImplementedError
+
+    def lock(self):
+        raise NotImplementedError
+
+
+class MemoryBackend(KvBackend):
+    def __init__(self):
+        self._data: Dict[str, Tuple[bytes, Optional[float]]] = {}
+        self._lock = threading.RLock()
+
+    def _expired(self, expiry: Optional[float]) -> bool:
+        return expiry is not None and time.time() > expiry
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            v = self._data.get(key)
+            if v is None or self._expired(v[1]):
+                return None
+            return v[0]
+
+    def get_from_prefix(self, prefix: str) -> List[Tuple[str, bytes]]:
+        with self._lock:
+            return [
+                (k, v)
+                for k, (v, exp) in sorted(self._data.items())
+                if k.startswith(prefix) and not self._expired(exp)
+            ]
+
+    def put(self, key: str, value: bytes, lease_secs: Optional[int] = None):
+        with self._lock:
+            expiry = time.time() + lease_secs if lease_secs else None
+            self._data[key] = (value, expiry)
+
+    def delete(self, key: str):
+        with self._lock:
+            self._data.pop(key, None)
+
+    def lock(self):
+        return self._lock
+
+
+class SqliteBackend(KvBackend):
+    """Durable KV over sqlite (WAL). One connection per thread."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._tls = threading.local()
+        self._lock = threading.RLock()
+        with self._conn() as c:
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS kv ("
+                "key TEXT PRIMARY KEY, value BLOB, expiry REAL)"
+            )
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self._path, timeout=30)
+            conn.execute("PRAGMA journal_mode=WAL")
+            self._tls.conn = conn
+        return conn
+
+    def get(self, key: str) -> Optional[bytes]:
+        row = self._conn().execute(
+            "SELECT value, expiry FROM kv WHERE key=?", (key,)
+        ).fetchone()
+        if row is None:
+            return None
+        if row[1] is not None and time.time() > row[1]:
+            return None
+        return row[0]
+
+    def get_from_prefix(self, prefix: str) -> List[Tuple[str, bytes]]:
+        rows = self._conn().execute(
+            "SELECT key, value, expiry FROM kv WHERE key >= ? AND key < ? "
+            "ORDER BY key",
+            (prefix, prefix + "\xff"),
+        ).fetchall()
+        now = time.time()
+        return [(k, v) for k, v, e in rows if e is None or now <= e]
+
+    def put(self, key: str, value: bytes, lease_secs: Optional[int] = None):
+        expiry = time.time() + lease_secs if lease_secs else None
+        with self._conn() as c:
+            c.execute(
+                "INSERT OR REPLACE INTO kv (key, value, expiry) VALUES (?,?,?)",
+                (key, value, expiry),
+            )
+
+    def delete(self, key: str):
+        with self._conn() as c:
+            c.execute("DELETE FROM kv WHERE key=?", (key,))
+
+    def lock(self):
+        return self._lock
+
+
+# ---------------------------------------------------------------------------
+# Scheduler state
+# ---------------------------------------------------------------------------
+
+
+class SchedulerState:
+    """Namespaced cluster state + scheduling queues.
+
+    Key scheme (reference: state/mod.rs:387-434):
+      /ballista/{ns}/executors/{id}
+      /ballista/{ns}/jobs/{job_id}
+      /ballista/{ns}/stages/{job_id}/{stage_id}
+      /ballista/{ns}/tasks/{job_id}/{stage_id}/{partition}
+    """
+
+    def __init__(self, backend: KvBackend, namespace: str = "default"):
+        self.kv = backend
+        self.ns = namespace
+        self._lock = threading.RLock()
+        # ready-queue of (job_id, stage_id, partition) runnable now
+        self._ready: List[PartitionId] = []
+        # stage dependency bookkeeping: (job, stage) -> [dep stage ids]
+        self._stage_deps: Dict[Tuple[str, int], List[int]] = {}
+        self._stage_parts: Dict[Tuple[str, int], int] = {}
+
+    # -- keys ---------------------------------------------------------------
+
+    def _k(self, *parts) -> str:
+        return "/ballista/" + self.ns + "/" + "/".join(str(p) for p in parts)
+
+    # -- executors ----------------------------------------------------------
+
+    def save_executor_metadata(self, meta: ExecutorMeta):
+        self.kv.put(self._k("executors", meta.id), pickle.dumps(meta),
+                    lease_secs=EXECUTOR_LEASE_SECS)
+
+    def get_executors_metadata(self) -> List[ExecutorMeta]:
+        return [
+            pickle.loads(v)
+            for _, v in self.kv.get_from_prefix(self._k("executors"))
+        ]
+
+    # -- jobs ---------------------------------------------------------------
+
+    def save_job_status(self, job_id: str, status: JobStatus):
+        self.kv.put(self._k("jobs", job_id), pickle.dumps(status))
+
+    def get_job_status(self, job_id: str) -> Optional[JobStatus]:
+        v = self.kv.get(self._k("jobs", job_id))
+        return pickle.loads(v) if v is not None else None
+
+    # -- stages -------------------------------------------------------------
+
+    def save_stage_plan(self, job_id: str, stage_id: int, plan_bytes: bytes,
+                        num_partitions: int, dep_stage_ids: List[int]):
+        self.kv.put(
+            self._k("stages", job_id, stage_id),
+            pickle.dumps((plan_bytes, num_partitions, dep_stage_ids)),
+        )
+        with self._lock:
+            self._stage_deps[(job_id, stage_id)] = list(dep_stage_ids)
+            self._stage_parts[(job_id, stage_id)] = num_partitions
+
+    def get_stage_plan(self, job_id: str, stage_id: int):
+        v = self.kv.get(self._k("stages", job_id, stage_id))
+        if v is None:
+            raise ClusterError(f"no stage plan {job_id}/{stage_id}")
+        return pickle.loads(v)  # (plan_bytes, num_partitions, deps)
+
+    def stage_ids(self, job_id: str) -> List[int]:
+        prefix = self._k("stages", job_id) + "/"
+        return sorted(
+            int(k[len(prefix):]) for k, _ in self.kv.get_from_prefix(prefix)
+        )
+
+    # -- tasks --------------------------------------------------------------
+
+    def save_task_status(self, st: TaskStatus):
+        self.kv.put(
+            self._k("tasks", st.partition.job_id, st.partition.stage_id,
+                    st.partition.partition_id),
+            pickle.dumps(st),
+        )
+
+    def get_task_statuses(self, job_id: str,
+                          stage_id: Optional[int] = None) -> List[TaskStatus]:
+        prefix = (
+            self._k("tasks", job_id, stage_id)
+            if stage_id is not None
+            else self._k("tasks", job_id)
+        )
+        return [pickle.loads(v) for _, v in self.kv.get_from_prefix(prefix)]
+
+    # -- scheduling ---------------------------------------------------------
+
+    def enqueue_job(self, job_id: str):
+        """Called once stage plans + empty task rows are persisted: seed the
+        ready-queue with every stage that has no pending dependencies."""
+        with self._lock:
+            for sid in self.stage_ids(job_id):
+                deps = self._stage_deps.get((job_id, sid), [])
+                if not deps:
+                    self._enqueue_stage(job_id, sid)
+
+    def _enqueue_stage(self, job_id: str, stage_id: int):
+        n = self._stage_parts[(job_id, stage_id)]
+        for p in range(n):
+            self._ready.append(PartitionId(job_id, stage_id, p))
+
+    def next_task(self) -> Optional[PartitionId]:
+        with self._lock:
+            if self._ready:
+                return self._ready.pop(0)
+        return None
+
+    def task_completed(self, st: TaskStatus):
+        """Record completion; if a whole stage just completed, unlock its
+        dependents (event-driven, replacing the reference's full scan)."""
+        self.save_task_status(st)
+        job_id = st.partition.job_id
+        stage_id = st.partition.stage_id
+        with self._lock:
+            stage_tasks = self.get_task_statuses(job_id, stage_id)
+            n = self._stage_parts.get((job_id, stage_id))
+            done = [t for t in stage_tasks if t.state == "completed"]
+            if n is None or len(done) < n:
+                return
+            # stage complete: enqueue dependents whose deps are all complete
+            for (j, sid), deps in list(self._stage_deps.items()):
+                if j != job_id or stage_id not in deps:
+                    continue
+                if all(self._stage_complete(j, d) for d in deps):
+                    if not self._stage_started(j, sid):
+                        self._enqueue_stage(j, sid)
+
+    def _stage_complete(self, job_id: str, stage_id: int) -> bool:
+        n = self._stage_parts.get((job_id, stage_id), 0)
+        done = [
+            t for t in self.get_task_statuses(job_id, stage_id)
+            if t.state == "completed"
+        ]
+        return len(done) >= n
+
+    def _stage_started(self, job_id: str, stage_id: int) -> bool:
+        return any(
+            t.state is not None
+            for t in self.get_task_statuses(job_id, stage_id)
+        ) or any(
+            p.job_id == job_id and p.stage_id == stage_id for p in self._ready
+        )
+
+    def stage_locations(self, job_id: str) -> Dict[int, List[PartitionLocation]]:
+        """Completed-task locations per stage (for shuffle resolution)."""
+        out: Dict[int, List[PartitionLocation]] = {}
+        executors = {e.id: e for e in self.get_executors_metadata()}
+        for t in self.get_task_statuses(job_id):
+            if t.state != "completed":
+                continue
+            e = executors.get(t.executor_id)
+            host, port = (e.host, e.port) if e else ("", 0)
+            out.setdefault(t.partition.stage_id, []).append(
+                PartitionLocation(
+                    job_id=t.partition.job_id,
+                    stage_id=t.partition.stage_id,
+                    partition_id=t.partition.partition_id,
+                    executor_id=t.executor_id or "",
+                    host=host,
+                    port=port,
+                    path=t.path or "",
+                    stats=t.stats,
+                )
+            )
+        return out
+
+    # -- job status synthesis (reference: state/mod.rs:267-358) --------------
+
+    def synchronize_job_status(self, job_id: str):
+        status = self.get_job_status(job_id)
+        if status is None or status.state in ("completed", "failed"):
+            return
+        tasks = self.get_task_statuses(job_id)
+        if not tasks:
+            return
+        if any(t.state == "failed" for t in tasks):
+            err = next(t.error for t in tasks if t.state == "failed")
+            self.save_job_status(job_id, JobStatus("failed", error=err))
+            return
+        final_sid = max(self.stage_ids(job_id))
+        final_tasks = [t for t in tasks if t.partition.stage_id == final_sid]
+        n = self._stage_parts.get((job_id, final_sid), len(final_tasks))
+        done = [t for t in final_tasks if t.state == "completed"]
+        if final_tasks and len(done) >= n:
+            locs = self.stage_locations(job_id).get(final_sid, [])
+            self.save_job_status(
+                job_id, JobStatus("completed", partition_locations=locs)
+            )
+        elif any(t.state is not None for t in tasks):
+            self.save_job_status(job_id, JobStatus("running"))

@@ -1,0 +1,55 @@
+"""Scheduler domain types (reference: rust/core/src/serde/scheduler/mod.rs:
+34-253 — Action/PartitionId/PartitionLocation/ExecutorMeta/PartitionStats)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass(frozen=True)
+class ExecutorMeta:
+    id: str
+    host: str
+    port: int  # data-plane port
+    num_devices: int = 1
+
+
+@dataclass(frozen=True)
+class PartitionId:
+    job_id: str
+    stage_id: int
+    partition_id: int
+
+    def key(self) -> str:
+        return f"{self.job_id}/{self.stage_id}/{self.partition_id}"
+
+
+@dataclass
+class PartitionLocation:
+    job_id: str
+    stage_id: int
+    partition_id: int
+    executor_id: str
+    host: str
+    port: int
+    path: str = ""
+    stats: Optional[Dict[str, int]] = None
+
+
+@dataclass
+class TaskStatus:
+    partition: PartitionId
+    # one of: None (pending), "running", "completed", "failed"
+    state: Optional[str] = None
+    executor_id: Optional[str] = None
+    error: Optional[str] = None
+    path: Optional[str] = None
+    stats: Optional[Dict[str, int]] = None
+
+
+@dataclass
+class JobStatus:
+    state: str  # queued|running|completed|failed
+    error: Optional[str] = None
+    partition_locations: Optional[list] = None

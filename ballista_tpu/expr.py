@@ -391,7 +391,15 @@ class Case(Expr):
     otherwise: Optional[Expr]
 
     def name(self) -> str:
-        return "CASE ... END"
+        parts = ["CASE"]
+        if self.base is not None:
+            parts.append(self.base.name())
+        for w, t in self.branches:
+            parts.append(f"WHEN {w.name()} THEN {t.name()}")
+        if self.otherwise is not None:
+            parts.append(f"ELSE {self.otherwise.name()}")
+        parts.append("END")
+        return " ".join(parts)
 
     def children(self) -> List[Expr]:
         out = [self.base] if self.base is not None else []

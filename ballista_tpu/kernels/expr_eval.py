@@ -55,6 +55,9 @@ class Evaluated:
     dtype: DataType
     validity: Optional[jax.Array] = None  # bool, None = all valid
     dictionary: Optional[Dictionary] = None
+    # set when this is a literal: the exact Python value, enabling exact
+    # decimal-vs-float-literal comparisons (no f32 boundary drift)
+    literal_value: object = None
 
     def valid_or(self, cap: int) -> jax.Array:
         if self.validity is None:
@@ -127,7 +130,10 @@ class Evaluator:
         v = e.value
         if e.dtype.kind == "decimal":
             v = int(round(float(v) * 10 ** e.dtype.scale))
-        return Evaluated(jnp.asarray(v, dtype=e.dtype.device_dtype()), e.dtype)
+        return Evaluated(
+            jnp.asarray(v, dtype=e.dtype.device_dtype()), e.dtype,
+            literal_value=e.value,
+        )
 
     # ------------------------------------------------------------- wrappers
 
@@ -230,8 +236,67 @@ class Evaluator:
         # utf8 handling
         if l.dtype.kind == "utf8" or r.dtype.kind == "utf8":
             return self._compare_utf8(op, l, r, validity)
+        # exact decimal column vs numeric literal: integer threshold compare
+        if l.dtype.kind == "decimal" and r.literal_value is not None \
+                and r.dtype.is_numeric and r.dtype.kind != "decimal":
+            res = self._compare_decimal_literal(op, l, r.literal_value, validity)
+            if res is not None:
+                return res
+        if r.dtype.kind == "decimal" and l.literal_value is not None \
+                and l.dtype.is_numeric and l.dtype.kind != "decimal":
+            flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+                    "=": "=", "!=": "!="}
+            res = self._compare_decimal_literal(
+                flip[op], r, l.literal_value, validity
+            )
+            if res is not None:
+                return res
         lv, rv = self._coerce_pair(l, r)
         return Evaluated(self._CMP[op](lv, rv), Boolean, validity)
+
+    _I64_MAX = (1 << 63) - 1
+    _I64_MIN = -(1 << 63)
+
+    def _compare_decimal_literal(self, op, col: Evaluated, lit_val,
+                                 validity) -> Optional[Evaluated]:
+        """decimal(s) column vs float/int literal without f32 drift: the
+        literal scales to c*10^s in host float64, then integer thresholds
+        (floor/ceil) make every comparison exact. Returns None for
+        non-finite literals (caller falls back to the generic float path,
+        where NaN compares all-false)."""
+        import math
+
+        n = col.values.shape
+        c = float(lit_val) * (10 ** col.dtype.scale)
+        if not math.isfinite(c):
+            return None
+        v = col.values.astype(jnp.int64)
+        # literals beyond int64 range: every value is on one side
+        if c > self._I64_MAX:
+            true_ops = ("<", "<=", "!=")
+        elif c < self._I64_MIN:
+            true_ops = (">", ">=", "!=")
+        else:
+            true_ops = None
+        if true_ops is not None:
+            fill = jnp.full(n, op in true_ops, dtype=jnp.bool_)
+            return Evaluated(fill, Boolean, validity)
+        # relative tolerance: double rounding error grows with |c|
+        is_int = abs(c - round(c)) <= max(1e-9, abs(c) * 1e-12)
+        ci = int(round(c))
+        if op == "=":
+            out = (v == ci) if is_int else jnp.zeros(n, jnp.bool_)
+        elif op == "!=":
+            out = (v != ci) if is_int else jnp.ones(n, jnp.bool_)
+        elif op == "<":
+            out = v < (ci if is_int else math.ceil(c))
+        elif op == "<=":
+            out = v <= (ci if is_int else math.floor(c))
+        elif op == ">":
+            out = v > (ci if is_int else math.floor(c))
+        else:  # >=
+            out = v >= (ci if is_int else math.ceil(c))
+        return Evaluated(out, Boolean, validity)
 
     def _compare_utf8(self, op, l: Evaluated, r: Evaluated, validity) -> Evaluated:
         # date column vs string literal

@@ -235,7 +235,7 @@ class HashAggregateExec(PhysicalPlan):
                     aggs = self._agg_inputs_partial(batch)
                 else:
                     key_evals = [
-                        self._ev.evaluate(ex.col(e.name()), batch)
+                        self._ev.evaluate(ex.ColumnRef(e.name()), batch)
                         for e in self.group_exprs
                     ]
                     aggs = self._agg_inputs_final(batch)

@@ -100,7 +100,7 @@ def create_physical_plan(plan: LogicalPlan) -> PhysicalPlan:
         want = plan.schema().names()
         got = joined.output_schema().names()
         if want != got:
-            joined = ProjectionExec([ex.col(n) for n in want], joined)
+            joined = ProjectionExec([ex.ColumnRef(n) for n in want], joined)
         return joined
 
     if isinstance(plan, EmptyRelation):

@@ -1,0 +1,136 @@
+"""Distributed shuffle operators.
+
+TPU-native equivalents of the reference's shuffle trio (reference:
+rust/core/src/execution_plans/{query_stage.rs,shuffle_reader.rs,
+unresolved_shuffle.rs}):
+
+- ``QueryStageExec`` marks a stage boundary; the executor runs its child for
+  one partition and materializes the (hash-partitioned) output;
+- ``UnresolvedShuffleExec`` is the planner's placeholder for inputs whose
+  producing stages haven't completed; it refuses to execute;
+- ``ShuffleReaderExec`` reads completed stage partitions: from the local
+  filesystem when the producer shares it, else over the data-plane socket.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional
+
+from ..columnar import ColumnBatch
+from ..datatypes import Schema
+from ..errors import ExecutionError
+from ..distributed.types import PartitionLocation
+from .base import PhysicalPlan, Partitioning
+
+
+class QueryStageExec(PhysicalPlan):
+    """Stage boundary marker (reference: query_stage.rs:29-85). Execution
+    (materializing output) is driven by the executor task runner, which
+    also applies the hash partitioning for the consuming stage."""
+
+    def __init__(self, job_id: str, stage_id: int, child: PhysicalPlan):
+        self.job_id = job_id
+        self.stage_id = stage_id
+        self.child = child
+
+    def output_schema(self) -> Schema:
+        return self.child.output_schema()
+
+    def output_partitioning(self) -> Partitioning:
+        return self.child.output_partitioning()
+
+    def children(self):
+        return [self.child]
+
+    def with_new_children(self, children):
+        return QueryStageExec(self.job_id, self.stage_id, children[0])
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        yield from self.child.execute(partition)
+
+    def display(self) -> str:
+        return f"QueryStageExec: job={self.job_id} stage={self.stage_id}"
+
+
+class UnresolvedShuffleExec(PhysicalPlan):
+    """Placeholder input (reference: unresolved_shuffle.rs:34-91)."""
+
+    def __init__(self, query_stage_ids: List[int], schema: Schema,
+                 partition_count: int):
+        self.query_stage_ids = list(query_stage_ids)
+        self._schema = schema
+        self.partition_count = partition_count
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning("unknown", self.partition_count)
+
+    def with_new_children(self, children):
+        return self
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        raise ExecutionError(
+            "UnresolvedShuffleExec cannot execute; the scheduler must "
+            "resolve it into a ShuffleReaderExec first"
+        )
+
+    def display(self) -> str:
+        return (
+            f"UnresolvedShuffleExec: stages={self.query_stage_ids} "
+            f"parts={self.partition_count}"
+        )
+
+
+class ShuffleReaderExec(PhysicalPlan):
+    """Reads one completed shuffle partition per output partition
+    (reference: shuffle_reader.rs:33-100 — partition index maps 1:1 to a
+    PartitionLocation)."""
+
+    def __init__(self, partition_locations: List[PartitionLocation],
+                 schema: Schema):
+        self.partition_locations = list(partition_locations)
+        self._schema = schema
+        self._cache: Optional[List[List[ColumnBatch]]] = None
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning("unknown", max(len(self.partition_locations), 1))
+
+    def with_new_children(self, children):
+        return self
+
+    def _load_all(self) -> List[List[ColumnBatch]]:
+        """Fetch every location once; utf8 dictionaries are unioned ACROSS
+        partitions so downstream concat/compare sees one interned
+        dictionary per column (producers encode independently)."""
+        if self._cache is not None:
+            return self._cache
+        from ..io import ipc
+
+        parts = []
+        for loc in self.partition_locations:
+            if loc.path and os.path.exists(loc.path):
+                _, arrays, nulls, dicts, _ = ipc.read_partition_arrays(loc.path)
+            else:
+                from ..distributed.dataplane import fetch_partition_bytes
+
+                buf = fetch_partition_bytes(
+                    loc.host, loc.port, loc.job_id, loc.stage_id,
+                    loc.partition_id,
+                )
+                _, arrays, nulls, dicts, _ = ipc.read_partition_arrays(buf)
+            parts.append((arrays, nulls, dicts))
+        batches = ipc.batches_from_parts(self._schema, parts)
+        self._cache = [[b] for b in batches]
+        return self._cache
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        yield from self._load_all()[partition]
+
+    def display(self) -> str:
+        return f"ShuffleReaderExec: {len(self.partition_locations)} partitions"

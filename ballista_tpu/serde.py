@@ -1,0 +1,526 @@
+"""Plan/expression <-> protobuf serde.
+
+Equivalent of the reference's serde layer (reference:
+rust/core/src/serde/logical_plan/{to_proto.rs,from_proto.rs} and
+serde/physical_plan/*; its roundtrip tests at serde/logical_plan/mod.rs:
+20-920 are the model for tests/test_serde.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .datatypes import (
+    Boolean,
+    DataType,
+    Date32,
+    Decimal,
+    Field,
+    Float32,
+    Float64,
+    Int32,
+    Int64,
+    Schema,
+    Utf8,
+)
+from .errors import SerdeError
+from . import expr as ex
+from . import logical as lp
+from .proto import ballista_pb2 as pb
+
+# ---------------------------------------------------------------------------
+# Types
+# ---------------------------------------------------------------------------
+
+
+def dtype_to_proto(dt: DataType) -> pb.DataType:
+    return pb.DataType(kind=dt.kind, scale=dt.scale)
+
+
+def dtype_from_proto(p: pb.DataType) -> DataType:
+    if p.kind == "decimal":
+        return Decimal(p.scale)
+    return DataType(p.kind)
+
+
+def schema_to_proto(s: Schema) -> pb.Schema:
+    return pb.Schema(
+        fields=[
+            pb.Field(name=f.name, dtype=dtype_to_proto(f.dtype), nullable=f.nullable)
+            for f in s.fields
+        ]
+    )
+
+
+def schema_from_proto(p: pb.Schema) -> Schema:
+    return Schema(
+        [Field(f.name, dtype_from_proto(f.dtype), f.nullable) for f in p.fields]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Expressions
+# ---------------------------------------------------------------------------
+
+
+def expr_to_proto(e: ex.Expr) -> pb.LogicalExprNode:
+    n = pb.LogicalExprNode()
+    if isinstance(e, ex.ColumnRef):
+        n.column.column = e.column
+        n.column.relation = e.relation or ""
+    elif isinstance(e, ex.Literal):
+        sv = n.literal
+        sv.dtype.CopyFrom(dtype_to_proto(e.dtype))
+        if e.value is None:
+            sv.null_value = True
+        elif e.dtype == Boolean:
+            sv.bool_value = bool(e.value)
+        elif e.dtype == Date32:
+            sv.date_value = int(e.value)
+        elif e.dtype.kind == "utf8":
+            sv.string_value = str(e.value)
+        elif e.dtype.is_integer or e.dtype.kind == "decimal":
+            sv.int_value = int(e.value)
+        else:
+            sv.float_value = float(e.value)
+    elif isinstance(e, ex.BinaryExpr):
+        n.binary.left.CopyFrom(expr_to_proto(e.left))
+        n.binary.op = e.op
+        n.binary.right.CopyFrom(expr_to_proto(e.right))
+    elif isinstance(e, ex.Not):
+        n.not_expr.CopyFrom(expr_to_proto(e.expr))
+    elif isinstance(e, ex.IsNull):
+        n.is_null.CopyFrom(expr_to_proto(e.expr))
+    elif isinstance(e, ex.IsNotNull):
+        n.is_not_null.CopyFrom(expr_to_proto(e.expr))
+    elif isinstance(e, ex.Alias):
+        n.alias.expr.CopyFrom(expr_to_proto(e.expr))
+        n.alias.alias = e.alias_name
+    elif isinstance(e, ex.Cast):
+        n.cast.expr.CopyFrom(expr_to_proto(e.expr))
+        n.cast.dtype.CopyFrom(dtype_to_proto(e.dtype))
+    elif isinstance(e, ex.InList):
+        n.in_list.expr.CopyFrom(expr_to_proto(e.expr))
+        for item in e.list:
+            n.in_list.list.append(expr_to_proto(item))
+        n.in_list.negated = e.negated
+    elif isinstance(e, ex.Like):
+        n.like.expr.CopyFrom(expr_to_proto(e.expr))
+        n.like.pattern = e.pattern
+        n.like.negated = e.negated
+    elif isinstance(e, ex.Case):
+        if e.base is not None:
+            n.case_expr.base.CopyFrom(expr_to_proto(e.base))
+        for w, t in e.branches:
+            b = n.case_expr.branches.add()
+            b.when.CopyFrom(expr_to_proto(w))
+            b.then.CopyFrom(expr_to_proto(t))
+        if e.otherwise is not None:
+            n.case_expr.otherwise.CopyFrom(expr_to_proto(e.otherwise))
+    elif isinstance(e, ex.ScalarFunction):
+        n.scalar_fn.fn = e.fn
+        for a in e.args:
+            n.scalar_fn.args.append(expr_to_proto(a))
+    elif isinstance(e, ex.AggregateExpr):
+        n.aggregate.fn = e.fn
+        n.aggregate.expr.CopyFrom(expr_to_proto(e.expr))
+        n.aggregate.is_star = e.is_star
+    elif isinstance(e, ex.SortExpr):
+        n.sort.expr.CopyFrom(expr_to_proto(e.expr))
+        n.sort.ascending = e.ascending
+        n.sort.nulls_first = e.nulls_first
+    else:
+        raise SerdeError(f"cannot serialize expr {type(e).__name__}")
+    return n
+
+
+def expr_from_proto(n: pb.LogicalExprNode) -> ex.Expr:
+    kind = n.WhichOneof("expr_type")
+    if kind == "column":
+        return ex.ColumnRef(n.column.column, n.column.relation or None)
+    if kind == "literal":
+        sv = n.literal
+        dt = dtype_from_proto(sv.dtype)
+        which = sv.WhichOneof("value")
+        if which == "null_value":
+            return ex.Literal(None, dt)
+        if which == "bool_value":
+            return ex.Literal(sv.bool_value, dt)
+        if which == "date_value":
+            return ex.Literal(sv.date_value, dt)
+        if which == "string_value":
+            return ex.Literal(sv.string_value, dt)
+        if which == "int_value":
+            return ex.Literal(sv.int_value, dt)
+        if which == "float_value":
+            return ex.Literal(sv.float_value, dt)
+        raise SerdeError("literal without value")
+    if kind == "binary":
+        return ex.BinaryExpr(
+            expr_from_proto(n.binary.left), n.binary.op,
+            expr_from_proto(n.binary.right),
+        )
+    if kind == "not_expr":
+        return ex.Not(expr_from_proto(n.not_expr))
+    if kind == "is_null":
+        return ex.IsNull(expr_from_proto(n.is_null))
+    if kind == "is_not_null":
+        return ex.IsNotNull(expr_from_proto(n.is_not_null))
+    if kind == "alias":
+        return ex.Alias(expr_from_proto(n.alias.expr), n.alias.alias)
+    if kind == "cast":
+        return ex.Cast(expr_from_proto(n.cast.expr), dtype_from_proto(n.cast.dtype))
+    if kind == "in_list":
+        return ex.InList(
+            expr_from_proto(n.in_list.expr),
+            [expr_from_proto(i) for i in n.in_list.list],
+            n.in_list.negated,
+        )
+    if kind == "like":
+        return ex.Like(expr_from_proto(n.like.expr), n.like.pattern, n.like.negated)
+    if kind == "case_expr":
+        base = (
+            expr_from_proto(n.case_expr.base)
+            if n.case_expr.HasField("base") else None
+        )
+        otherwise = (
+            expr_from_proto(n.case_expr.otherwise)
+            if n.case_expr.HasField("otherwise") else None
+        )
+        return ex.Case(
+            base,
+            [(expr_from_proto(b.when), expr_from_proto(b.then))
+             for b in n.case_expr.branches],
+            otherwise,
+        )
+    if kind == "scalar_fn":
+        return ex.ScalarFunction(
+            n.scalar_fn.fn, [expr_from_proto(a) for a in n.scalar_fn.args]
+        )
+    if kind == "aggregate":
+        return ex.AggregateExpr(
+            n.aggregate.fn, expr_from_proto(n.aggregate.expr), n.aggregate.is_star
+        )
+    if kind == "sort":
+        return ex.SortExpr(
+            expr_from_proto(n.sort.expr), n.sort.ascending, n.sort.nulls_first
+        )
+    raise SerdeError(f"unknown expr node {kind}")
+
+
+# ---------------------------------------------------------------------------
+# Table sources
+# ---------------------------------------------------------------------------
+
+
+def source_to_proto(src: lp.TableSource, primary_key: Optional[str] = None
+                    ) -> pb.TableSourceDesc:
+    d = src.source_descriptor()
+    return pb.TableSourceDesc(
+        kind=d.get("kind", ""),
+        path=d.get("path", ""),
+        delimiter=d.get("delimiter", ""),
+        has_header=bool(d.get("has_header", False)),
+        schema=schema_to_proto(src.table_schema()),
+        primary_key=primary_key or "",
+        num_partitions=d.get("num_partitions", 0),
+    )
+
+
+def source_from_proto(p: pb.TableSourceDesc) -> lp.TableSource:
+    from .io import CsvSource, ParquetSource, TblSource
+
+    schema = schema_from_proto(p.schema)
+    if p.kind == "tbl":
+        return TblSource(p.path, schema)
+    if p.kind == "csv":
+        return CsvSource(p.path, schema, has_header=p.has_header,
+                         delimiter=p.delimiter or ",")
+    if p.kind == "parquet":
+        return ParquetSource(p.path, schema)
+    raise SerdeError(f"source kind {p.kind!r} is not remotable")
+
+
+# ---------------------------------------------------------------------------
+# Logical plans
+# ---------------------------------------------------------------------------
+
+
+def plan_to_proto(plan: lp.LogicalPlan) -> pb.LogicalPlanNode:
+    n = pb.LogicalPlanNode()
+    if isinstance(plan, lp.TableScan):
+        n.scan.table_name = plan.table_name
+        n.scan.source.CopyFrom(source_to_proto(plan.source))
+        if plan.projection is not None:
+            n.scan.has_projection = True
+            n.scan.projection.extend(plan.projection)
+    elif isinstance(plan, lp.Projection):
+        n.projection.input.CopyFrom(plan_to_proto(plan.input))
+        for e in plan.exprs:
+            n.projection.exprs.append(expr_to_proto(e))
+    elif isinstance(plan, lp.Filter):
+        n.filter.input.CopyFrom(plan_to_proto(plan.input))
+        n.filter.predicate.CopyFrom(expr_to_proto(plan.predicate))
+    elif isinstance(plan, lp.Aggregate):
+        n.aggregate.input.CopyFrom(plan_to_proto(plan.input))
+        for e in plan.group_exprs:
+            n.aggregate.group_exprs.append(expr_to_proto(e))
+        for e in plan.agg_exprs:
+            n.aggregate.agg_exprs.append(expr_to_proto(e))
+    elif isinstance(plan, lp.Join):
+        n.join.left.CopyFrom(plan_to_proto(plan.left))
+        n.join.right.CopyFrom(plan_to_proto(plan.right))
+        for l, r in plan.on:
+            o = n.join.on.add()
+            o.left_col = l
+            o.right_col = r
+        n.join.how = plan.how
+    elif isinstance(plan, lp.Sort):
+        n.sort.input.CopyFrom(plan_to_proto(plan.input))
+        for e in plan.sort_exprs:
+            n.sort.sort_exprs.append(expr_to_proto(e))
+    elif isinstance(plan, lp.Limit):
+        n.limit.input.CopyFrom(plan_to_proto(plan.input))
+        n.limit.n = plan.n
+    elif isinstance(plan, lp.Repartition):
+        n.repartition.input.CopyFrom(plan_to_proto(plan.input))
+        n.repartition.num_partitions = plan.num_partitions
+        for e in plan.hash_exprs or []:
+            n.repartition.hash_exprs.append(expr_to_proto(e))
+    elif isinstance(plan, lp.EmptyRelation):
+        n.empty.produce_one_row = plan.produce_one_row
+    else:
+        raise SerdeError(f"cannot serialize plan {type(plan).__name__}")
+    return n
+
+
+def plan_from_proto(n: pb.LogicalPlanNode) -> lp.LogicalPlan:
+    kind = n.WhichOneof("plan_type")
+    if kind == "scan":
+        src = source_from_proto(n.scan.source)
+        proj = tuple(n.scan.projection) if n.scan.has_projection else None
+        return lp.TableScan(n.scan.table_name, src, proj)
+    if kind == "projection":
+        return lp.Projection(
+            [expr_from_proto(e) for e in n.projection.exprs],
+            plan_from_proto(n.projection.input),
+        )
+    if kind == "filter":
+        return lp.Filter(
+            expr_from_proto(n.filter.predicate), plan_from_proto(n.filter.input)
+        )
+    if kind == "aggregate":
+        return lp.Aggregate(
+            [expr_from_proto(e) for e in n.aggregate.group_exprs],
+            [expr_from_proto(e) for e in n.aggregate.agg_exprs],
+            plan_from_proto(n.aggregate.input),
+        )
+    if kind == "join":
+        return lp.Join(
+            plan_from_proto(n.join.left),
+            plan_from_proto(n.join.right),
+            [(o.left_col, o.right_col) for o in n.join.on],
+            n.join.how,
+        )
+    if kind == "sort":
+        return lp.Sort(
+            [expr_from_proto(e) for e in n.sort.sort_exprs],
+            plan_from_proto(n.sort.input),
+        )
+    if kind == "limit":
+        return lp.Limit(n.limit.n, plan_from_proto(n.limit.input))
+    if kind == "repartition":
+        hx = [expr_from_proto(e) for e in n.repartition.hash_exprs]
+        return lp.Repartition(
+            plan_from_proto(n.repartition.input),
+            n.repartition.num_partitions,
+            hx or None,
+        )
+    if kind == "empty":
+        return lp.EmptyRelation(n.empty.produce_one_row)
+    raise SerdeError(f"unknown plan node {kind}")
+
+
+# ---------------------------------------------------------------------------
+# Physical plans
+# ---------------------------------------------------------------------------
+
+
+def physical_to_proto(plan) -> pb.PhysicalPlanNode:
+    from .physical.aggregate import HashAggregateExec
+    from .physical.join import JoinExec
+    from .physical import operators as ops
+    from .physical.shuffle import ShuffleReaderExec, UnresolvedShuffleExec
+
+    n = pb.PhysicalPlanNode()
+    if isinstance(plan, ops.ScanExec):
+        n.scan.table_name = plan.table_name
+        n.scan.source.CopyFrom(source_to_proto(plan.source))
+        if plan.projection is not None:
+            n.scan.has_projection = True
+            n.scan.projection.extend(plan.projection)
+    elif isinstance(plan, ops.ProjectionExec):
+        n.projection.input.CopyFrom(physical_to_proto(plan.child))
+        for e in plan.exprs:
+            n.projection.exprs.append(expr_to_proto(e))
+    elif isinstance(plan, ops.FilterExec):
+        n.filter.input.CopyFrom(physical_to_proto(plan.child))
+        n.filter.predicate.CopyFrom(expr_to_proto(plan.predicate))
+    elif isinstance(plan, HashAggregateExec):
+        n.hash_aggregate.input.CopyFrom(physical_to_proto(plan.child))
+        n.hash_aggregate.mode = plan.mode
+        for e in plan.group_exprs:
+            n.hash_aggregate.group_exprs.append(expr_to_proto(e))
+        for e in plan.agg_exprs:
+            n.hash_aggregate.agg_exprs.append(expr_to_proto(e))
+        n.hash_aggregate.group_capacity = plan.group_capacity
+    elif isinstance(plan, JoinExec):
+        n.join.build.CopyFrom(physical_to_proto(plan.build))
+        n.join.probe.CopyFrom(physical_to_proto(plan.probe))
+        for l, r in plan.on:
+            o = n.join.on.add()
+            o.left_col = l
+            o.right_col = r
+        n.join.how = plan.how
+    elif isinstance(plan, ops.SortExec):
+        n.sort.input.CopyFrom(physical_to_proto(plan.child))
+        for e in plan.sort_exprs:
+            n.sort.sort_exprs.append(expr_to_proto(e))
+    elif isinstance(plan, ops.LimitExec):
+        n.limit.input.CopyFrom(physical_to_proto(plan.child))
+        n.limit.n = plan.n
+    elif isinstance(plan, ops.MergeExec):
+        n.merge.input.CopyFrom(physical_to_proto(plan.child))
+    elif isinstance(plan, ops.CoalesceBatchesExec):
+        n.coalesce_batches.input.CopyFrom(physical_to_proto(plan.child))
+    elif isinstance(plan, ops.RepartitionExec):
+        n.repartition.input.CopyFrom(physical_to_proto(plan.child))
+        n.repartition.num_partitions = plan.num_partitions
+        for e in plan.hash_exprs or []:
+            n.repartition.hash_exprs.append(expr_to_proto(e))
+    elif isinstance(plan, ShuffleReaderExec):
+        for loc in plan.partition_locations:
+            n.shuffle_reader.partition_location.append(location_to_proto(loc))
+        n.shuffle_reader.schema.CopyFrom(schema_to_proto(plan.output_schema()))
+    elif isinstance(plan, UnresolvedShuffleExec):
+        n.unresolved_shuffle.query_stage_ids.extend(plan.query_stage_ids)
+        n.unresolved_shuffle.schema.CopyFrom(schema_to_proto(plan.output_schema()))
+        n.unresolved_shuffle.partition_count = plan.partition_count
+    elif isinstance(plan, ops.EmptyExec):
+        n.empty.produce_one_row = plan.produce_one_row
+    else:
+        raise SerdeError(f"cannot serialize physical plan {type(plan).__name__}")
+    return n
+
+
+def physical_from_proto(n: pb.PhysicalPlanNode):
+    from .physical.aggregate import HashAggregateExec
+    from .physical.join import JoinExec
+    from .physical import operators as ops
+    from .physical.shuffle import ShuffleReaderExec, UnresolvedShuffleExec
+
+    kind = n.WhichOneof("plan_type")
+    if kind == "scan":
+        src = source_from_proto(n.scan.source)
+        proj = list(n.scan.projection) if n.scan.has_projection else None
+        return ops.ScanExec(n.scan.table_name, src, proj)
+    if kind == "projection":
+        return ops.ProjectionExec(
+            [expr_from_proto(e) for e in n.projection.exprs],
+            physical_from_proto(n.projection.input),
+        )
+    if kind == "filter":
+        return ops.FilterExec(
+            expr_from_proto(n.filter.predicate),
+            physical_from_proto(n.filter.input),
+        )
+    if kind == "hash_aggregate":
+        return HashAggregateExec(
+            n.hash_aggregate.mode,
+            [expr_from_proto(e) for e in n.hash_aggregate.group_exprs],
+            [expr_from_proto(e) for e in n.hash_aggregate.agg_exprs],
+            physical_from_proto(n.hash_aggregate.input),
+            n.hash_aggregate.group_capacity or 4096,
+        )
+    if kind == "join":
+        return JoinExec(
+            physical_from_proto(n.join.build),
+            physical_from_proto(n.join.probe),
+            [(o.left_col, o.right_col) for o in n.join.on],
+            n.join.how,
+        )
+    if kind == "sort":
+        return ops.SortExec(
+            [expr_from_proto(e) for e in n.sort.sort_exprs],
+            physical_from_proto(n.sort.input),
+        )
+    if kind == "limit":
+        return ops.LimitExec(n.limit.n, physical_from_proto(n.limit.input))
+    if kind == "merge":
+        return ops.MergeExec(physical_from_proto(n.merge.input))
+    if kind == "coalesce_batches":
+        return ops.CoalesceBatchesExec(
+            physical_from_proto(n.coalesce_batches.input)
+        )
+    if kind == "repartition":
+        hx = [expr_from_proto(e) for e in n.repartition.hash_exprs]
+        return ops.RepartitionExec(
+            physical_from_proto(n.repartition.input),
+            n.repartition.num_partitions,
+            hx or None,
+        )
+    if kind == "shuffle_reader":
+        return ShuffleReaderExec(
+            [location_from_proto(l) for l in n.shuffle_reader.partition_location],
+            schema_from_proto(n.shuffle_reader.schema),
+        )
+    if kind == "unresolved_shuffle":
+        return UnresolvedShuffleExec(
+            list(n.unresolved_shuffle.query_stage_ids),
+            schema_from_proto(n.unresolved_shuffle.schema),
+            n.unresolved_shuffle.partition_count,
+        )
+    if kind == "empty":
+        return ops.EmptyExec(n.empty.produce_one_row)
+    raise SerdeError(f"unknown physical node {kind}")
+
+
+# ---------------------------------------------------------------------------
+# Scheduling metadata helpers
+# ---------------------------------------------------------------------------
+
+
+def location_to_proto(loc) -> pb.PartitionLocation:
+    """loc: distributed.types.PartitionLocation."""
+    p = pb.PartitionLocation()
+    p.partition_id.job_id = loc.job_id
+    p.partition_id.stage_id = loc.stage_id
+    p.partition_id.partition_id = loc.partition_id
+    p.executor_meta.id = loc.executor_id
+    p.executor_meta.host = loc.host
+    p.executor_meta.port = loc.port
+    p.path = loc.path or ""
+    if loc.stats is not None:
+        p.partition_stats.num_rows = loc.stats.get("num_rows", 0)
+        p.partition_stats.num_batches = loc.stats.get("num_batches", 0)
+        p.partition_stats.num_bytes = loc.stats.get("num_bytes", 0)
+    return p
+
+
+def location_from_proto(p: pb.PartitionLocation):
+    from .distributed.types import PartitionLocation
+
+    return PartitionLocation(
+        job_id=p.partition_id.job_id,
+        stage_id=p.partition_id.stage_id,
+        partition_id=p.partition_id.partition_id,
+        executor_id=p.executor_meta.id,
+        host=p.executor_meta.host,
+        port=p.executor_meta.port,
+        path=p.path,
+        stats={
+            "num_rows": p.partition_stats.num_rows,
+            "num_batches": p.partition_stats.num_batches,
+            "num_bytes": p.partition_stats.num_bytes,
+        },
+    )

@@ -1,0 +1,406 @@
+"""SQL AST -> LogicalPlan.
+
+Replaces the DataFusion SQL planner the reference leans on (reference:
+rust/client/src/context.rs:131-144; scheduler-side planning at
+rust/scheduler/src/lib.rs:224-407). Key responsibilities:
+
+- name resolution against a catalog of registered tables, with table
+  aliases and qualified column refs;
+- join graph extraction: explicit JOIN ... ON plus TPC-H-style comma FROM +
+  WHERE equality conjuncts become a greedy join chain whose build sides are
+  chosen by primary-key heuristics (build side must be the unique-key side
+  for the FK fast path — see physical/join.py);
+- aggregate extraction: SELECT/HAVING/ORDER BY expressions over aggregates
+  are rewritten to reference generated aggregate output columns;
+- DISTINCT -> group-by-all; ordinal GROUP BY/ORDER BY references.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..datatypes import Schema
+from ..errors import PlanError, SqlError
+from .. import expr as ex
+from ..logical import (
+    Aggregate,
+    Filter,
+    Join,
+    Limit,
+    LogicalPlan,
+    Projection,
+    Sort,
+    TableScan,
+    TableSource,
+)
+from .parser import JoinClause, OrderItem, Query, SelectItem, TableRef
+
+
+@dataclass
+class CatalogTable:
+    name: str
+    source: TableSource
+    primary_key: Optional[str] = None  # unique column, for join-side choice
+
+
+class SqlPlanner:
+    def __init__(self, catalog: Dict[str, CatalogTable]):
+        self.catalog = catalog
+
+    # ------------------------------------------------------------------ API
+
+    def plan(self, q: Query) -> LogicalPlan:
+        if q.from_table is None:
+            raise SqlError("SELECT without FROM not supported yet")
+
+        tables = self._resolve_tables(q)
+        where_conjuncts = (
+            self._qualify_conjuncts(q.where, tables) if q.where is not None else []
+        )
+        plan, remaining = self._plan_joins(q, tables, where_conjuncts)
+        if remaining:
+            from ..optimizer import conjoin
+
+            plan = Filter(conjoin(remaining), plan)
+
+        plan = self._plan_select(q, plan)
+        return plan
+
+    # -------------------------------------------------------- FROM resolution
+
+    def _resolve_tables(self, q: Query) -> List[Tuple[str, CatalogTable]]:
+        """[(alias, table)] in FROM order."""
+        out = []
+        refs = [q.from_table] + [j.table for j in q.joins]
+        for r in refs:
+            if r.name not in self.catalog:
+                raise SqlError(f"unknown table {r.name!r}")
+            out.append((r.alias or r.name, self.catalog[r.name]))
+        return out
+
+    def _owner_of(self, colname: str, tables) -> Optional[str]:
+        """alias of the table owning an unqualified column name."""
+        owner = None
+        for alias, t in tables:
+            if t.source.table_schema().has_field(colname):
+                if owner is not None:
+                    raise SqlError(f"ambiguous column {colname!r}")
+                owner = alias
+        return owner
+
+    def _qualify(self, e: ex.Expr, tables) -> ex.Expr:
+        """Resolve qualified refs (alias.col -> col) after checking owners."""
+        if isinstance(e, ex.ColumnRef):
+            if e.relation is not None:
+                aliases = {a for a, _ in tables}
+                if e.relation not in aliases:
+                    raise SqlError(f"unknown table alias {e.relation!r}")
+                return ex.ColumnRef(e.column)
+            if self._owner_of(e.column, tables) is None:
+                raise SqlError(f"unknown column {e.column!r}")
+            return e
+        for attr in ("expr", "left", "right", "base", "otherwise"):
+            if hasattr(e, attr) and isinstance(getattr(e, attr), ex.Expr):
+                setattr(e, attr, self._qualify(getattr(e, attr), tables))
+        if hasattr(e, "args"):
+            e.args = [self._qualify(a, tables) for a in e.args]
+        if hasattr(e, "list"):
+            e.list = [self._qualify(a, tables) for a in e.list]
+        if hasattr(e, "branches"):
+            e.branches = [
+                (self._qualify(w, tables), self._qualify(t, tables))
+                for w, t in e.branches
+            ]
+        return e
+
+    def _qualify_conjuncts(self, where: ex.Expr, tables) -> List[ex.Expr]:
+        from ..optimizer import split_conjuncts
+
+        return [self._qualify(c, tables) for c in split_conjuncts(where)]
+
+    # ------------------------------------------------------------ join graph
+
+    def _plan_joins(self, q: Query, tables, conjuncts):
+        """Greedy join chain; returns (plan, leftover conjuncts).
+
+        Build-side choice: when adding table T to the accumulated plan via
+        edge (acc_col = t_col), use Join(left=T, right=acc) iff t_col is T's
+        primary key (fast FK probe into acc), else Join(left=acc, right=T)
+        iff acc_col is unique in acc; else default to build=T (runtime
+        expanding join handles duplicates).
+        """
+        alias_schema = {a: t.source.table_schema() for a, t in tables}
+        col_owner: Dict[str, str] = {}
+        for a, t in tables:
+            for n in t.source.table_schema().names():
+                # later duplicates are ambiguous; _owner_of catches misuse
+                col_owner.setdefault(n, a)
+
+        # single-table fast path
+        if len(tables) == 1:
+            alias, t = tables[0]
+            return TableScan(t.name, t.source), conjuncts
+
+        # classify conjuncts
+        def owners(e: ex.Expr) -> Set[str]:
+            return {col_owner[c] for c in ex.referenced_columns(e) if c in col_owner}
+
+        join_edges: List[Tuple[str, str, str, str]] = []  # (a1, c1, a2, c2)
+        table_filters: Dict[str, List[ex.Expr]] = {a: [] for a, _ in tables}
+        post: List[ex.Expr] = []
+        for c in conjuncts:
+            if (
+                isinstance(c, ex.BinaryExpr) and c.op == "="
+                and isinstance(c.left, ex.ColumnRef)
+                and isinstance(c.right, ex.ColumnRef)
+            ):
+                o1 = col_owner.get(c.left.column)
+                o2 = col_owner.get(c.right.column)
+                if o1 and o2 and o1 != o2:
+                    join_edges.append((o1, c.left.column, o2, c.right.column))
+                    continue
+            os = owners(c)
+            if len(os) == 1:
+                table_filters[next(iter(os))].append(c)
+            else:
+                post.append(c)
+
+        # explicit JOIN ... ON clauses contribute edges / filters too
+        explicit_how: Dict[str, str] = {}
+        for j in q.joins:
+            alias = j.table.alias or j.table.name
+            if j.how != "cross":
+                explicit_how[alias] = j.how
+            if j.on is not None:
+                for c in self._qualify_conjuncts(j.on, tables):
+                    if (
+                        isinstance(c, ex.BinaryExpr) and c.op == "="
+                        and isinstance(c.left, ex.ColumnRef)
+                        and isinstance(c.right, ex.ColumnRef)
+                    ):
+                        o1 = col_owner.get(c.left.column)
+                        o2 = col_owner.get(c.right.column)
+                        if o1 and o2 and o1 != o2:
+                            join_edges.append((o1, c.left.column, o2, c.right.column))
+                            continue
+                    post.append(c)
+
+        def scan_with_filters(alias: str) -> LogicalPlan:
+            t = dict(tables)[alias]
+            p: LogicalPlan = TableScan(t.name, t.source)
+            from ..optimizer import conjoin
+
+            if table_filters[alias]:
+                p = Filter(conjoin(table_filters[alias]), p)
+            return p
+
+        # greedy chain in FROM order
+        joined: Set[str] = {tables[0][0]}
+        plan = scan_with_filters(tables[0][0])
+        # unique cols currently valid for the accumulated plan's rows
+        acc_unique: Set[str] = set()
+        pk0 = dict(tables)[tables[0][0]].primary_key
+        if pk0:
+            acc_unique.add(pk0)
+        pending = [a for a, _ in tables[1:]]
+        edges = list(join_edges)
+
+        while pending:
+            progress = False
+            for alias in list(pending):
+                # find an edge connecting alias to the joined set
+                edge = None
+                used = None
+                for e_ in edges:
+                    a1, c1, a2, c2 = e_
+                    if a1 == alias and a2 in joined:
+                        edge, used = (alias, c1, a2, c2), e_
+                        break
+                    if a2 == alias and a1 in joined:
+                        edge, used = (alias, c2, a1, c1), e_
+                        break
+                if edge is None:
+                    continue
+                t_alias, t_col, _, acc_col = edge
+                t = dict(tables)[t_alias]
+                t_plan = scan_with_filters(t_alias)
+                how = explicit_how.get(t_alias, "inner")
+                if t.primary_key == t_col:
+                    # build the new (dimension) table, probe the acc
+                    plan = Join(t_plan, plan, [(t_col, acc_col)], how)
+                    # acc row granularity unchanged -> acc_unique survives
+                elif acc_col in acc_unique:
+                    plan = Join(plan, t_plan, [(acc_col, t_col)], how)
+                    acc_unique = {t.primary_key} if t.primary_key else set()
+                else:
+                    plan = Join(t_plan, plan, [(t_col, acc_col)], how)
+                joined.add(t_alias)
+                pending.remove(t_alias)
+                edges.remove(used)
+                # leftover edges between already-joined tables become
+                # post-join equality filters (e.g. q5's c_nationkey =
+                # s_nationkey once both sides are in the chain)
+                resolved = [
+                    e_ for e_ in edges if e_[0] in joined and e_[2] in joined
+                ]
+                for a1, c1, a2, c2 in resolved:
+                    post.append(ex.BinaryExpr(ex.col(c1), "=", ex.col(c2)))
+                edges = [e_ for e_ in edges if e_ not in resolved]
+                progress = True
+            if not progress:
+                raise SqlError(
+                    f"no join condition connects tables {pending} to the rest"
+                )
+        return plan, post
+
+    # -------------------------------------------------- SELECT/agg/order/limit
+
+    def _plan_select(self, q: Query, plan: LogicalPlan) -> LogicalPlan:
+        in_schema = plan.schema()
+
+        # expand stars
+        items: List[SelectItem] = []
+        for it in q.items:
+            if it.star:
+                for n in in_schema.names():
+                    items.append(SelectItem(ex.ColumnRef(n), None))
+            else:
+                items.append(it)
+
+        select_exprs = [
+            it.expr.alias(it.alias) if it.alias else it.expr for it in items
+        ]
+
+        # resolve GROUP BY entries (ordinals / aliases / exprs)
+        group_exprs: List[ex.Expr] = []
+        for g in q.group_by:
+            group_exprs.append(self._resolve_ref(g, items, in_schema))
+
+        has_aggs = any(self._contains_agg(e) for e in select_exprs) or (
+            q.having is not None and self._contains_agg(q.having)
+        )
+        distinct = q.distinct
+
+        if group_exprs or has_aggs:
+            plan = self._plan_aggregate(q, plan, select_exprs, group_exprs)
+        else:
+            if distinct:
+                # DISTINCT == group by all output columns
+                proj = Projection(select_exprs, plan)
+                names = proj.schema().names()
+                plan = Aggregate([ex.ColumnRef(n) for n in names], [], proj)
+                distinct = False
+            else:
+                plan = Projection(select_exprs, plan)
+
+        out_schema = plan.schema()
+
+        # ORDER BY (may reference output aliases, ordinals, or input cols)
+        if q.order_by:
+            sort_exprs = []
+            for oi in q.order_by:
+                e = self._resolve_order_ref(oi.expr, items, out_schema)
+                sort_exprs.append(ex.SortExpr(e, oi.ascending,
+                                              bool(oi.nulls_first)))
+            plan = Sort(sort_exprs, plan)
+
+        if q.limit is not None:
+            plan = Limit(q.limit, plan)
+        return plan
+
+    def _plan_aggregate(self, q: Query, plan, select_exprs, group_exprs):
+        # collect aggregate subexpressions across SELECT + HAVING + ORDER BY
+        aggs: List[ex.AggregateExpr] = []
+
+        def collect(e: ex.Expr):
+            for node in ex.walk(e):
+                if isinstance(node, ex.AggregateExpr):
+                    if not any(node is a or a.name() == node.name() for a in aggs):
+                        aggs.append(node)
+
+        for e in select_exprs:
+            collect(e)
+        if q.having is not None:
+            collect(q.having)
+        for oi in q.order_by:
+            collect(oi.expr)
+
+        agg_plan = Aggregate(group_exprs, list(aggs), plan)
+        agg_schema = agg_plan.schema()
+
+        group_names = {g.name() for g in group_exprs}
+
+        def rewrite(e: ex.Expr) -> ex.Expr:
+            """Replace aggregate subtrees / group exprs with output col refs."""
+            if isinstance(e, ex.Alias):
+                return ex.Alias(rewrite(e.expr), e.alias_name)
+            if isinstance(e, ex.AggregateExpr):
+                return ex.ColumnRef(e.name())
+            if e.name() in group_names:
+                return ex.ColumnRef(e.name())
+            for attr in ("expr", "left", "right", "base", "otherwise"):
+                if hasattr(e, attr) and isinstance(getattr(e, attr), ex.Expr):
+                    setattr(e, attr, rewrite(getattr(e, attr)))
+            if hasattr(e, "args"):
+                e.args = [rewrite(a) for a in e.args]
+            if hasattr(e, "list"):
+                e.list = [rewrite(a) for a in e.list]
+            if hasattr(e, "branches"):
+                e.branches = [(rewrite(w), rewrite(t)) for w, t in e.branches]
+            return e
+
+        out: LogicalPlan = agg_plan
+        if q.having is not None:
+            out = Filter(rewrite(self._resolve_ref(q.having, [], agg_schema)), out)
+        projected = [rewrite(e) for e in select_exprs]
+        # validate non-aggregate select exprs reference group cols only
+        for e in projected:
+            for node in ex.walk(e):
+                if isinstance(node, ex.ColumnRef) and not agg_schema.has_field(
+                    node.column
+                ):
+                    raise SqlError(
+                        f"column {node.column!r} is neither grouped nor aggregated"
+                    )
+        return Projection(projected, out)
+
+    # ------------------------------------------------------------- reference
+    # resolution helpers
+
+    def _resolve_ref(self, e: ex.Expr, items: List[SelectItem], schema: Schema):
+        # ordinal (1-based)
+        if isinstance(e, ex.Literal) and e.dtype.is_integer and items:
+            idx = int(e.value) - 1
+            if 0 <= idx < len(items):
+                return items[idx].expr
+            raise SqlError(f"ordinal {e.value} out of range")
+        # output alias
+        if isinstance(e, ex.ColumnRef) and not schema.has_field(e.column):
+            for it in items:
+                if it.alias == e.column:
+                    return it.expr
+        return e
+
+    def _resolve_order_ref(self, e: ex.Expr, items, out_schema: Schema):
+        if isinstance(e, ex.Literal) and e.dtype.is_integer:
+            idx = int(e.value) - 1
+            names = out_schema.names()
+            if 0 <= idx < len(names):
+                return ex.ColumnRef(names[idx])
+            raise SqlError(f"ordinal {e.value} out of range")
+        if isinstance(e, ex.AggregateExpr):
+            if out_schema.has_field(e.name()):
+                return ex.ColumnRef(e.name())
+            raise SqlError(f"ORDER BY aggregate {e.name()} not in output")
+        if isinstance(e, ex.ColumnRef):
+            if out_schema.has_field(e.column):
+                return e
+            for it in items:
+                if it.alias == e.column:
+                    return it.expr
+            raise SqlError(f"unknown ORDER BY column {e.column!r}")
+        return e
+
+    def _contains_agg(self, e: ex.Expr) -> bool:
+        return any(isinstance(n, ex.AggregateExpr) for n in ex.walk(e))

@@ -1,0 +1,218 @@
+"""TPC-H-like data generator (numpy, vectorized).
+
+Produces dbgen-compatible ``.tbl`` layout (| separated, trailing |) with the
+standard schemas, row-count ratios, and value distributions/correlations the
+benchmark queries rely on (date-correlated returnflag/linestatus, price =
+f(partkey), etc.). It is NOT bit-identical to official dbgen (different
+RNG), so golden results come from the pandas oracle in oracle.py rather
+than the spec's answer sets. Reference equivalent: dockerized dbgen
+(reference: rust/benchmarks/tpch/tpch-gen.sh:1-16).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import os
+
+import numpy as np
+
+EPOCH = np.datetime64("1970-01-01", "D")
+START = np.datetime64("1992-01-01", "D")
+END_ORDER = np.datetime64("1998-08-02", "D")
+CUTOFF = np.datetime64("1995-06-17", "D")  # returnflag/linestatus boundary
+
+NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+]
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SHIPMODES = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
+INSTRUCTIONS = ["COLLECT COD", "DELIVER IN PERSON", "NONE", "TAKE BACK RETURN"]
+CONTAINERS = ["SM CASE", "SM BOX", "MED BAG", "MED BOX", "LG CASE", "LG BOX",
+              "JUMBO PACK", "WRAP CASE"]
+TYPE_S1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+TYPE_S2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+TYPE_S3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+BRANDS = [f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)]
+NOUNS = ["packages", "requests", "accounts", "deposits", "foxes", "ideas",
+         "theodolites", "pinto beans", "instructions", "dependencies"]
+VERBS = ["sleep", "wake", "haggle", "nag", "cajole", "detect", "integrate",
+         "boost", "doze", "wake blithely"]
+
+
+def _comments(rng, n):
+    a = rng.choice(NOUNS, n)
+    b = rng.choice(VERBS, n)
+    c = rng.integers(0, 1000, n).astype(str)
+    return np.char.add(np.char.add(np.char.add(a, " "), b), np.char.add(" #", c))
+
+
+def _money(rng, n, lo, hi):
+    return rng.integers(int(lo * 100), int(hi * 100), n) / 100.0
+
+
+def _write_tbl(path, cols, num_parts=1):
+    """Write columns (list of np arrays) as .tbl partition files."""
+    n = len(cols[0])
+    os.makedirs(path, exist_ok=True)
+    per = -(-n // num_parts)
+    for p in range(num_parts):
+        lo, hi = p * per, min((p + 1) * per, n)
+        if lo >= hi and p > 0:
+            continue
+        strs = []
+        for c in cols:
+            if np.issubdtype(np.asarray(c).dtype, np.floating):
+                strs.append(np.char.mod("%.2f", c[lo:hi]))
+            elif np.asarray(c).dtype.kind == "M":  # datetime64
+                strs.append(np.datetime_as_string(c[lo:hi], unit="D"))
+            else:
+                strs.append(np.asarray(c[lo:hi]).astype(str))
+        lines = strs[0]
+        for s in strs[1:]:
+            lines = np.char.add(np.char.add(lines, "|"), s)
+        lines = np.char.add(lines, "|")
+        with open(os.path.join(path, f"partition{p}.tbl"), "w") as f:
+            f.write("\n".join(lines.tolist()))
+            f.write("\n")
+
+
+def generate(data_dir: str, scale: float = 0.01, num_parts: int = 2,
+             seed: int = 7) -> None:
+    rng = np.random.default_rng(seed)
+    n_cust = max(int(150_000 * scale), 10)
+    n_ord = n_cust * 10
+    n_part = max(int(200_000 * scale), 20)
+    n_supp = max(int(10_000 * scale), 5)
+    n_psupp = n_part * 4
+
+    # region / nation ------------------------------------------------------
+    _write_tbl(os.path.join(data_dir, "region"), [
+        np.arange(5), np.asarray(REGIONS),
+        _comments(rng, 5),
+    ], 1)
+    _write_tbl(os.path.join(data_dir, "nation"), [
+        np.arange(25), np.asarray([n for n, _ in NATIONS]),
+        np.asarray([r for _, r in NATIONS]), _comments(rng, 25),
+    ], 1)
+
+    # supplier -------------------------------------------------------------
+    skey = np.arange(1, n_supp + 1)
+    _write_tbl(os.path.join(data_dir, "supplier"), [
+        skey,
+        np.char.add("Supplier#", skey.astype(str)),
+        np.char.add("Addr S", rng.integers(0, 10**6, n_supp).astype(str)),
+        rng.integers(0, 25, n_supp),
+        np.char.add("27-", rng.integers(10**6, 10**7, n_supp).astype(str)),
+        _money(rng, n_supp, -999.99, 9999.99),
+        _comments(rng, n_supp),
+    ], 1)
+
+    # customer -------------------------------------------------------------
+    ckey = np.arange(1, n_cust + 1)
+    _write_tbl(os.path.join(data_dir, "customer"), [
+        ckey,
+        np.char.add("Customer#", ckey.astype(str)),
+        np.char.add("Addr C", rng.integers(0, 10**6, n_cust).astype(str)),
+        rng.integers(0, 25, n_cust),
+        np.char.add("27-", rng.integers(10**6, 10**7, n_cust).astype(str)),
+        _money(rng, n_cust, -999.99, 9999.99),
+        rng.choice(SEGMENTS, n_cust),
+        _comments(rng, n_cust),
+    ], num_parts)
+
+    # part -----------------------------------------------------------------
+    pkey = np.arange(1, n_part + 1)
+    ptype = np.char.add(
+        np.char.add(np.char.add(rng.choice(TYPE_S1, n_part), " "),
+                    np.char.add(rng.choice(TYPE_S2, n_part), " ")),
+        rng.choice(TYPE_S3, n_part),
+    )
+    retail = (90000 + (pkey % 20001) + 100 * (pkey % 1000)) / 100.0
+    _write_tbl(os.path.join(data_dir, "part"), [
+        pkey,
+        np.char.add("part name ", rng.choice(NOUNS, n_part)),
+        np.char.add("Manufacturer#", rng.integers(1, 6, n_part).astype(str)),
+        rng.choice(BRANDS, n_part),
+        ptype,
+        rng.integers(1, 51, n_part),
+        rng.choice(CONTAINERS, n_part),
+        retail,
+        _comments(rng, n_part),
+    ], num_parts)
+
+    # partsupp (4 suppliers per part, dbgen layout) -------------------------
+    ps_pkey = np.repeat(pkey, 4)
+    ps_skey = ((ps_pkey - 1 + np.tile(np.arange(4), n_part) *
+                (n_supp // 4 + 1)) % n_supp) + 1
+    _write_tbl(os.path.join(data_dir, "partsupp"), [
+        ps_pkey, ps_skey,
+        rng.integers(1, 10000, n_psupp),
+        _money(rng, n_psupp, 1.00, 1000.00),
+        _comments(rng, n_psupp),
+    ], num_parts)
+
+    # orders ---------------------------------------------------------------
+    okey = np.arange(1, n_ord + 1) * 4 - 3  # sparse keys like dbgen
+    o_cust = rng.integers(1, n_cust + 1, n_ord)
+    span = int((END_ORDER - START) / np.timedelta64(1, "D"))
+    o_date = START + rng.integers(0, span, n_ord).astype("timedelta64[D]")
+    _write_tbl(os.path.join(data_dir, "orders"), [
+        okey, o_cust,
+        rng.choice(["O", "F", "P"], n_ord, p=[0.49, 0.49, 0.02]),
+        _money(rng, n_ord, 1000.0, 400000.0),
+        o_date,
+        rng.choice(PRIORITIES, n_ord),
+        np.char.add("Clerk#", rng.integers(1, 1000, n_ord).astype(str)),
+        np.zeros(n_ord, dtype=np.int64),
+        _comments(rng, n_ord),
+    ], num_parts)
+
+    # lineitem -------------------------------------------------------------
+    n_lines_per = rng.integers(1, 8, n_ord)
+    l_okey = np.repeat(okey, n_lines_per)
+    l_odate = np.repeat(o_date, n_lines_per)
+    n_li = len(l_okey)
+    l_pkey = rng.integers(1, n_part + 1, n_li)
+    l_skey = ((l_pkey - 1 + rng.integers(0, 4, n_li) * (n_supp // 4 + 1))
+              % n_supp) + 1
+    l_lnum = np.concatenate([np.arange(1, k + 1) for k in n_lines_per])
+    qty = rng.integers(1, 51, n_li)
+    retail_of = (90000 + (l_pkey % 20001) + 100 * (l_pkey % 1000)) / 100.0
+    eprice = np.round(qty * retail_of, 2)
+    disc = rng.integers(0, 11, n_li) / 100.0
+    tax = rng.integers(0, 9, n_li) / 100.0
+    sdate = l_odate + rng.integers(1, 122, n_li).astype("timedelta64[D]")
+    cdate = l_odate + rng.integers(30, 91, n_li).astype("timedelta64[D]")
+    rdate = sdate + rng.integers(1, 31, n_li).astype("timedelta64[D]")
+    returned = rdate <= CUTOFF
+    rflag = np.where(returned,
+                     np.where(rng.random(n_li) < 0.5, "R", "A"), "N")
+    lstatus = np.where(sdate > CUTOFF, "O", "F")
+    _write_tbl(os.path.join(data_dir, "lineitem"), [
+        l_okey, l_pkey, l_skey, l_lnum,
+        qty.astype(np.float64), eprice, disc, tax,
+        rflag, lstatus, sdate, cdate, rdate,
+        rng.choice(INSTRUCTIONS, n_li),
+        rng.choice(SHIPMODES, n_li),
+        _comments(rng, n_li),
+    ], num_parts)
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--scale", type=float, default=0.01)
+    ap.add_argument("--parts", type=int, default=2)
+    args = ap.parse_args()
+    generate(args.out, args.scale, args.parts)
+    print(f"generated TPC-H-like data at scale {args.scale} in {args.out}")

@@ -1,0 +1,163 @@
+"""Independent pandas implementations of the TPC-H queries, used as the
+correctness oracle for the engine (golden results; the reference eyeballs a
+known q1 table, rust/benchmarks/tpch/README.md:70-84 — we assert
+programmatically instead)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pandas as pd
+
+from .schema_def import TPCH_SCHEMAS
+
+_D = lambda s: np.datetime64(s, "D")
+
+
+def load_tables(data_dir: str) -> dict:
+    out = {}
+    for name, sch in TPCH_SCHEMAS.items():
+        base = os.path.join(data_dir, name)
+        files = (
+            sorted(
+                os.path.join(base, f) for f in os.listdir(base)
+                if f.endswith(".tbl")
+            )
+            if os.path.isdir(base)
+            else [base + ".tbl"]
+        )
+        names = list(sch.names()) + ["__t"]
+        parts = [
+            pd.read_csv(f, sep="|", header=None, names=names,
+                        usecols=range(len(sch)))
+            for f in files
+        ]
+        df = pd.concat(parts, ignore_index=True)
+        for f_ in sch.fields:
+            if f_.dtype.kind == "date32":
+                df[f_.name] = pd.to_datetime(df[f_.name]).values.astype(
+                    "datetime64[D]"
+                )
+        out[name] = df
+    return out
+
+
+def q1(t):
+    l = t["lineitem"]
+    d = l[l.l_shipdate <= _D("1998-09-02")]
+    g = d.groupby(["l_returnflag", "l_linestatus"])
+
+    def agg(x):
+        disc = x.l_extendedprice * (1 - x.l_discount)
+        return pd.Series({
+            "sum_qty": x.l_quantity.sum(),
+            "sum_base_price": x.l_extendedprice.sum(),
+            "sum_disc_price": disc.sum(),
+            "sum_charge": (disc * (1 + x.l_tax)).sum(),
+            "avg_qty": x.l_quantity.mean(),
+            "avg_price": x.l_extendedprice.mean(),
+            "avg_disc": x.l_discount.mean(),
+            "count_order": len(x),
+        })
+
+    return (
+        g.apply(agg, include_groups=False)
+        .reset_index()
+        .sort_values(["l_returnflag", "l_linestatus"])
+        .reset_index(drop=True)
+    )
+
+
+def q3(t):
+    c = t["customer"]; o = t["orders"]; l = t["lineitem"]
+    c = c[c.c_mktsegment == "BUILDING"]
+    o = o[o.o_orderdate < _D("1995-03-15")]
+    l = l[l.l_shipdate > _D("1995-03-15")]
+    j = l.merge(o, left_on="l_orderkey", right_on="o_orderkey").merge(
+        c, left_on="o_custkey", right_on="c_custkey"
+    )
+    j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+    out = (
+        j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])["revenue"]
+        .sum()
+        .reset_index()[["l_orderkey", "revenue", "o_orderdate", "o_shippriority"]]
+        .sort_values(["revenue", "o_orderdate"], ascending=[False, True])
+        .head(10)
+        .reset_index(drop=True)
+    )
+    return out
+
+
+def q5(t):
+    c, o, l = t["customer"], t["orders"], t["lineitem"]
+    s, n, r = t["supplier"], t["nation"], t["region"]
+    r = r[r.r_name == "ASIA"]
+    o = o[(o.o_orderdate >= _D("1994-01-01")) & (o.o_orderdate < _D("1995-01-01"))]
+    j = (
+        l.merge(o, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+        .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+    )
+    j = j[j.c_nationkey == j.s_nationkey]
+    j = j.merge(n, left_on="s_nationkey", right_on="n_nationkey").merge(
+        r, left_on="n_regionkey", right_on="r_regionkey"
+    )
+    j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+    return (
+        j.groupby("n_name")["revenue"].sum().reset_index()
+        .sort_values("revenue", ascending=False).reset_index(drop=True)
+    )
+
+
+def q6(t):
+    l = t["lineitem"]
+    d = l[
+        (l.l_shipdate >= _D("1994-01-01")) & (l.l_shipdate < _D("1995-01-01"))
+        & (l.l_discount >= 0.05) & (l.l_discount <= 0.07) & (l.l_quantity < 24)
+    ]
+    return pd.DataFrame({"revenue": [(d.l_extendedprice * d.l_discount).sum()]})
+
+
+def q10(t):
+    c, o, l, n = t["customer"], t["orders"], t["lineitem"], t["nation"]
+    o = o[(o.o_orderdate >= _D("1993-10-01")) & (o.o_orderdate < _D("1994-01-01"))]
+    l = l[l.l_returnflag == "R"]
+    j = (
+        l.merge(o, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+        .merge(n, left_on="c_nationkey", right_on="n_nationkey")
+    )
+    j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+    out = (
+        j.groupby(["c_custkey", "c_name", "c_acctbal", "c_phone", "n_name",
+                   "c_address", "c_comment"])["revenue"].sum().reset_index()
+    )
+    out = out[["c_custkey", "c_name", "revenue", "c_acctbal", "n_name",
+               "c_address", "c_phone", "c_comment"]]
+    return (
+        out.sort_values("revenue", ascending=False).head(20).reset_index(drop=True)
+    )
+
+
+def q12(t):
+    o, l = t["orders"], t["lineitem"]
+    d = l[
+        l.l_shipmode.isin(["MAIL", "SHIP"])
+        & (l.l_commitdate < l.l_receiptdate)
+        & (l.l_shipdate < l.l_commitdate)
+        & (l.l_receiptdate >= _D("1994-01-01"))
+        & (l.l_receiptdate < _D("1995-01-01"))
+    ]
+    j = d.merge(o, left_on="l_orderkey", right_on="o_orderkey")
+    high = j.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
+    out = (
+        j.assign(high=high.astype(int), low=(~high).astype(int))
+        .groupby("l_shipmode")[["high", "low"]].sum().reset_index()
+        .rename(columns={"high": "high_line_count", "low": "low_line_count"})
+        .sort_values("l_shipmode").reset_index(drop=True)
+    )
+    return out
+
+
+ORACLES = {"q1": q1, "q3": q3, "q5": q5, "q6": q6, "q10": q10, "q12": q12}

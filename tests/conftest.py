@@ -8,12 +8,21 @@ rust/scheduler/src/lib.rs:444-491 tests gRPC services via direct calls).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment points JAX at the (slow, single-chip)
+# axon TPU tunnel; tests want the fast virtual 8-device CPU topology.
+# NOTE: the interpreter's sitecustomize imports jax at startup with
+# JAX_PLATFORMS=axon already read, so the env var alone is too late —
+# jax.config.update below is what actually flips the platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

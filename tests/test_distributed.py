@@ -1,0 +1,214 @@
+"""Distributed runtime tests: state machine, direct service calls, and an
+in-process cluster running real queries end-to-end.
+
+Test style follows the reference (reference: rust/scheduler/src/lib.rs:
+444-491 invokes poll_work directly with tonic::Request — no sockets; state
+tests against temp sled at state/mod.rs:450-787) plus what it lacks: a real
+multi-executor end-to-end query with shuffle."""
+
+import time
+
+import numpy as np
+import pytest
+
+from ballista_tpu import schema, col, lit, sum_, count, Int64, Decimal, Utf8
+from ballista_tpu.distributed.executor import LocalCluster
+from ballista_tpu.distributed.scheduler import SchedulerService
+from ballista_tpu.distributed.state import (
+    MemoryBackend,
+    SchedulerState,
+    SqliteBackend,
+)
+from ballista_tpu.distributed.types import (
+    ExecutorMeta,
+    JobStatus,
+    PartitionId,
+    TaskStatus,
+)
+from ballista_tpu.logical import LogicalPlanBuilder
+from ballista_tpu.proto import ballista_pb2 as pb
+from ballista_tpu import serde
+
+
+# ---------------------------------------------------------------------------
+# KV + state machine
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("backend_fn", [
+    lambda tmp: MemoryBackend(),
+    lambda tmp: SqliteBackend(str(tmp / "state.db")),
+])
+def test_kv_backend(tmp_path, backend_fn):
+    kv = backend_fn(tmp_path)
+    kv.put("/a/b", b"1")
+    kv.put("/a/c", b"2")
+    kv.put("/b/d", b"3")
+    assert kv.get("/a/b") == b"1"
+    assert kv.get("/missing") is None
+    assert [k for k, _ in kv.get_from_prefix("/a")] == ["/a/b", "/a/c"]
+    kv.delete("/a/b")
+    assert kv.get("/a/b") is None
+
+
+def test_executor_lease(tmp_path):
+    st = SchedulerState(MemoryBackend())
+    st.save_executor_metadata(ExecutorMeta("e1", "h", 1, 1))
+    assert len(st.get_executors_metadata()) == 1
+
+
+def test_job_status_machine():
+    st = SchedulerState(MemoryBackend())
+    st.save_job_status("j1", JobStatus("queued"))
+    st.save_stage_plan("j1", 1, b"x", 2, [])
+    st.save_stage_plan("j1", 2, b"y", 1, [1])
+    for p in range(2):
+        st.save_task_status(TaskStatus(PartitionId("j1", 1, p)))
+    st.save_task_status(TaskStatus(PartitionId("j1", 2, 0)))
+    st.enqueue_job("j1")
+
+    # only stage 1 tasks are ready (stage 2 depends on stage 1)
+    t1, t2 = st.next_task(), st.next_task()
+    assert {t1.stage_id, t2.stage_id} == {1}
+    assert st.next_task() is None
+
+    st.save_executor_metadata(ExecutorMeta("e1", "h", 1))
+    for t in (t1, t2):
+        st.task_completed(
+            TaskStatus(t, "completed", executor_id="e1", path="p", stats={})
+        )
+    # stage 1 complete -> stage 2 becomes ready
+    t3 = st.next_task()
+    assert t3 is not None and t3.stage_id == 2
+    st.task_completed(
+        TaskStatus(t3, "completed", executor_id="e1", path="p", stats={})
+    )
+    st.synchronize_job_status("j1")
+    js = st.get_job_status("j1")
+    assert js.state == "completed"
+    assert len(js.partition_locations) == 1
+
+
+def test_failed_task_fails_job():
+    st = SchedulerState(MemoryBackend())
+    st.save_job_status("j2", JobStatus("queued"))
+    st.save_stage_plan("j2", 1, b"x", 1, [])
+    st.save_task_status(TaskStatus(PartitionId("j2", 1, 0)))
+    st.enqueue_job("j2")
+    t = st.next_task()
+    st.save_task_status(TaskStatus(t, "failed", error="boom"))
+    st.synchronize_job_status("j2")
+    js = st.get_job_status("j2")
+    assert js.state == "failed" and "boom" in js.error
+
+
+# ---------------------------------------------------------------------------
+# Direct service calls (no sockets)
+# ---------------------------------------------------------------------------
+
+
+def _mem_table(tmp_path):
+    p = tmp_path / "t.tbl"
+    lines = [f"{i}|{(i % 7) + 0.25:.2f}|k{i % 3}|" for i in range(100)]
+    p.write_text("\n".join(lines) + "\n")
+    from ballista_tpu.io import TblSource
+
+    s = schema(("a", Int64), ("b", Decimal(2)), ("c", Utf8))
+    return TblSource(str(p), s)
+
+
+def test_poll_work_direct(tmp_path):
+    svc = SchedulerService(SchedulerState(MemoryBackend()))
+    src = _mem_table(tmp_path)
+    plan = (
+        LogicalPlanBuilder.scan("t", src)
+        .aggregate([col("c")], [sum_(col("b")).alias("s")])
+        .build()
+    )
+    params = pb.ExecuteQueryParams()
+    params.logical_plan.CopyFrom(serde.plan_to_proto(plan))
+    job_id = svc.ExecuteQuery(params).job_id
+    assert len(job_id) == 7
+
+    # wait for background planning
+    deadline = time.time() + 10
+    while not svc.state.stage_ids(job_id):
+        assert time.time() < deadline, "planning never finished"
+        time.sleep(0.05)
+
+    poll = pb.PollWorkParams(can_accept_task=True)
+    poll.metadata.id = "e1"
+    poll.metadata.host = "localhost"
+    poll.metadata.port = 7777
+    result = svc.PollWork(poll)
+    assert result.HasField("task")
+    assert result.task.task_id.job_id == job_id
+    # executor now registered
+    got = svc.GetExecutorsMetadata(pb.GetExecutorsMetadataParams())
+    assert [e.id for e in got.metadata] == ["e1"]
+
+
+# ---------------------------------------------------------------------------
+# In-process cluster end-to-end
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = LocalCluster(num_executors=2, concurrent_tasks=2)
+    yield c
+    c.shutdown()
+
+
+def test_cluster_query_end_to_end(cluster, tmp_path):
+    src = _mem_table(tmp_path)
+    from ballista_tpu.client import BallistaContext
+
+    ctx = BallistaContext.remote("localhost", cluster.port)
+    ctx.register_source("t", src)
+    df = ctx.sql(
+        "select c, sum(b) as s, count(*) as n from t group by c order by c"
+    )
+    got = df.collect()
+
+    import pandas as pd
+
+    a = np.arange(100)
+    exp = (
+        pd.DataFrame({"c": [f"k{i % 3}" for i in a], "b": (a % 7) + 0.25})
+        .groupby("c")
+        .agg(s=("b", "sum"), n=("b", "size"))
+        .reset_index()
+        .sort_values("c")
+    )
+    np.testing.assert_array_equal(got["c"], exp["c"])
+    np.testing.assert_allclose(got["s"], exp["s"], rtol=1e-9)
+    np.testing.assert_array_equal(got["n"], exp["n"])
+
+
+def test_cluster_join_query(cluster, tmp_path):
+    from ballista_tpu.client import BallistaContext
+
+    d = tmp_path / "dim.tbl"
+    d.write_text("".join(f"{i}|cat{i % 2}|\n" for i in range(3)))
+    f = tmp_path / "fact.tbl"
+    f.write_text("".join(f"{i}|{i % 3}|{i + 0.5:.2f}|\n" for i in range(30)))
+    from ballista_tpu.io import TblSource
+
+    dim_s = schema(("dkey", Int64), ("cat", Utf8))
+    fact_s = schema(("fid", Int64), ("fkey", Int64), ("v", Decimal(2)))
+    ctx = BallistaContext.remote("localhost", cluster.port)
+    ctx.register_source("dim", TblSource(str(d), dim_s), primary_key="dkey")
+    ctx.register_source("fact", TblSource(str(f), fact_s))
+    got = ctx.sql(
+        "select cat, sum(v) as sv from fact, dim "
+        "where fkey = dkey group by cat order by cat"
+    ).collect()
+    import pandas as pd
+
+    a = np.arange(30)
+    fact_df = pd.DataFrame({"fkey": a % 3, "v": a + 0.5})
+    fact_df["cat"] = fact_df.fkey.map(lambda k: f"cat{k % 2}")
+    exp = fact_df.groupby("cat").v.sum().reset_index().sort_values("cat")
+    np.testing.assert_array_equal(got["cat"], exp["cat"])
+    np.testing.assert_allclose(got["sv"], exp["v"], rtol=1e-9)

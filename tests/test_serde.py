@@ -1,0 +1,124 @@
+"""Plan/expression proto roundtrip tests.
+
+Models the reference's strongest suite (reference:
+rust/core/src/serde/logical_plan/mod.rs:20-920 — 25 roundtrip tests
+comparing debug strings after proto->plan->proto)."""
+
+import datetime as dt
+
+import pytest
+
+from ballista_tpu import (
+    schema, col, lit, date_lit, sum_, avg, min_, max_, count,
+    Int32, Int64, Decimal, Utf8, Date32, Boolean, Float64,
+)
+from ballista_tpu import expr as ex
+from ballista_tpu import serde
+from ballista_tpu.io import CsvSource, TblSource
+from ballista_tpu.logical import LogicalPlanBuilder, TableScan
+
+
+@pytest.fixture(scope="module")
+def tbl_source(tmp_path_factory):
+    p = tmp_path_factory.mktemp("serde") / "t.tbl"
+    p.write_text("1|2.50|x|1995-01-01|\n2|3.75|y|1996-06-15|\n")
+    s = schema(("a", Int64), ("b", Decimal(2)), ("c", Utf8), ("d", Date32))
+    return TblSource(str(p), s)
+
+
+EXPRS = [
+    col("a"),
+    ex.ColumnRef("x", "t"),
+    lit(42),
+    lit(1.5),
+    lit("hello"),
+    lit(True),
+    lit(None),
+    date_lit("1998-09-02"),
+    ex.Literal(12345, Decimal(2)),
+    (col("a") + lit(1)) * col("b"),
+    (col("a") >= lit(10)) & ~(col("c") == lit("x")),
+    col("a").is_null(),
+    col("a").is_not_null(),
+    col("b").alias("renamed"),
+    ex.Cast(col("a"), Decimal(4)),
+    ex.InList(col("c"), [lit("p"), lit("q")], negated=True),
+    ex.Like(col("c"), "%foo_", negated=False),
+    ex.case().when(col("a") == lit(1), lit("one")).otherwise(lit("many")),
+    ex.Case(col("a"), [(lit(1), lit(10))], None),
+    ex.ScalarFunction("sqrt", [col("b")]),
+    ex.ScalarFunction("substr", [col("c"), lit(1), lit(2)]),
+    sum_(col("b")),
+    avg(col("b")),
+    min_(col("a")),
+    max_(col("a")),
+    count(),
+    count(col("c")),
+    ex.SortExpr(col("a"), ascending=False, nulls_first=True),
+]
+
+
+@pytest.mark.parametrize("e", EXPRS, ids=lambda e: e.name()[:40])
+def test_expr_roundtrip(e):
+    p = serde.expr_to_proto(e)
+    e2 = serde.expr_from_proto(p)
+    assert e2.name() == e.name()
+    # double roundtrip must be byte-stable
+    assert serde.expr_to_proto(e2).SerializeToString() == p.SerializeToString()
+
+
+def plans(src):
+    b = LogicalPlanBuilder.scan("t", src)
+    return [
+        b.build(),
+        b.filter((col("a") > lit(1)) & (col("d") < date_lit("1996-01-01"))).build(),
+        b.project([col("a"), (col("b") * lit(2)).alias("bb")]).build(),
+        b.aggregate([col("c")], [sum_(col("b")).alias("s"), count().alias("n")]).build(),
+        b.sort([ex.SortExpr(col("b"), ascending=False)]).limit(5).build(),
+        b.repartition(4, [col("a")]).build(),
+        b.join(LogicalPlanBuilder.scan("t2", src), on=[("a", "a")], how="left").build(),
+    ]
+
+
+def test_plan_roundtrips(tbl_source):
+    for plan in plans(tbl_source):
+        p = serde.plan_to_proto(plan)
+        plan2 = serde.plan_from_proto(p)
+        assert plan2.pretty() == plan.pretty()
+        assert plan2.schema() == plan.schema()
+        assert serde.plan_to_proto(plan2).SerializeToString() == p.SerializeToString()
+
+
+def test_physical_plan_roundtrip(tbl_source):
+    from ballista_tpu.execution import plan_logical
+    from ballista_tpu import serde as sd
+
+    plan = (
+        LogicalPlanBuilder.scan("t", tbl_source)
+        .filter(col("a") > lit(0))
+        .aggregate([col("c")], [sum_(col("b")).alias("s")])
+        .sort([ex.SortExpr(col("s"), ascending=False)])
+        .limit(3)
+        .build()
+    )
+    phys = plan_logical(plan)
+    p = sd.physical_to_proto(phys)
+    phys2 = sd.physical_from_proto(p)
+    assert phys2.pretty() == phys.pretty()
+    assert sd.physical_to_proto(phys2).SerializeToString() == p.SerializeToString()
+
+
+def test_physical_roundtrip_executes(tbl_source):
+    """Deserialized physical plans must actually run (the executor path)."""
+    from ballista_tpu.execution import collect_physical, plan_logical
+
+    plan = (
+        LogicalPlanBuilder.scan("t", tbl_source)
+        .aggregate([], [sum_(col("b")).alias("s"), count().alias("n")])
+        .build()
+    )
+    phys = plan_logical(plan)
+    phys2 = serde.physical_from_proto(serde.physical_to_proto(phys))
+    out = collect_physical(phys2)
+    assert float(out["s"][0]) == pytest.approx(6.25)
+    assert int(out["n"][0]) == 2

@@ -1,0 +1,60 @@
+"""TPC-H integration tests: SQL text -> engine results vs pandas oracle.
+
+The engine-level equivalent of the reference's docker-compose TPC-H
+integration run (reference: dev/integration-tests.sh:1-11, query set
+q1,q3,q5,q6,q10,q12 from rust/benchmarks/tpch/run.sh:6-9) — but with
+programmatic golden assertions instead of eyeballing."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from benchmarks.tpch import datagen, oracle
+from benchmarks.tpch.schema_def import register_tpch
+
+QUERIES = ["q1", "q3", "q5", "q6", "q10", "q12"]
+QDIR = os.path.join(os.path.dirname(__file__), "..", "benchmarks", "tpch", "queries")
+
+
+@pytest.fixture(scope="session")
+def tpch(tmp_path_factory):
+    data_dir = str(tmp_path_factory.mktemp("tpch_data"))
+    datagen.generate(data_dir, scale=0.002, num_parts=2)
+    from ballista_tpu.client import BallistaContext
+
+    ctx = BallistaContext.standalone()
+    register_tpch(ctx, data_dir, "tbl")
+    tables = oracle.load_tables(data_dir)
+    return ctx, tables
+
+
+def normalize(df: pd.DataFrame) -> pd.DataFrame:
+    out = df.copy()
+    for c in out.columns:
+        if out[c].dtype.kind == "M":
+            out[c] = out[c].values.astype("datetime64[D]")
+    return out.reset_index(drop=True)
+
+
+@pytest.mark.parametrize("qname", QUERIES)
+def test_tpch_query(tpch, qname):
+    ctx, tables = tpch
+    sql = open(os.path.join(QDIR, f"{qname}.sql")).read()
+    got = normalize(ctx.sql(sql).collect())
+    exp = normalize(oracle.ORACLES[qname](tables))
+
+    assert list(got.columns) == list(exp.columns), (got.columns, exp.columns)
+    assert len(got) == len(exp), f"{qname}: {len(got)} rows vs {len(exp)}"
+    for c in exp.columns:
+        g, e = got[c], exp[c]
+        if e.dtype.kind in "fc":
+            np.testing.assert_allclose(
+                g.astype(float), e.astype(float), rtol=1e-6, atol=1e-6,
+                err_msg=f"{qname}.{c}",
+            )
+        else:
+            np.testing.assert_array_equal(
+                g.to_numpy(), e.to_numpy(), err_msg=f"{qname}.{c}"
+            )
