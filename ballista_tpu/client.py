@@ -69,13 +69,20 @@ class BallistaContext:
     # -- registration (reference: context.rs:110-129) -----------------------
 
     def register_source(self, name: str, source: TableSource,
-                        primary_key: Optional[str] = None) -> None:
+                        primary_key: Optional[str] = None,
+                        cached: bool = False) -> None:
+        if cached:
+            from .io import CacheSource
+
+            source = CacheSource(source)
         pk = primary_key or _default_pk(source.table_schema())
         self._catalog[name] = CatalogTable(name, source, pk)
 
     def register_tbl(self, name: str, path: str, schema: Schema,
-                     primary_key: Optional[str] = None, **kw) -> None:
-        self.register_source(name, TblSource(path, schema, **kw), primary_key)
+                     primary_key: Optional[str] = None, cached: bool = False,
+                     **kw) -> None:
+        self.register_source(name, TblSource(path, schema, **kw), primary_key,
+                             cached=cached)
 
     def register_csv(self, name: str, path: str, schema: Schema,
                      has_header: bool = True,
@@ -163,6 +170,9 @@ class DataFrame:
     def __init__(self, ctx: BallistaContext, plan: Optional[LogicalPlan]):
         self.ctx = ctx
         self._plan = plan
+        # standalone mode caches the physical plan across collect() calls so
+        # operator jit caches (and table caches) are reused
+        self._phys = None
 
     # -- plan access --------------------------------------------------------
 
@@ -232,6 +242,14 @@ class DataFrame:
 
     def collect(self):
         """Execute and return a pandas DataFrame."""
+        if self.ctx.mode == "standalone":
+            import pandas as pd
+
+            from .execution import collect_physical, plan_logical
+
+            if self._phys is None:
+                self._phys = plan_logical(self.plan)
+            return pd.DataFrame(collect_physical(self._phys))
         return self.ctx._collect(self.plan)
 
     def to_pandas(self):

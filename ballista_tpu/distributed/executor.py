@@ -142,14 +142,18 @@ class Executor:
             stats = ipc.write_partition(path, batches)
         else:
             # empty partition: write an empty file with the plan schema
-            from ..columnar import ColumnBatch
+            # (utf8 columns need an — empty — dictionary for IPC encode)
+            from ..columnar import ColumnBatch, Dictionary
             import numpy as np
-            import jax.numpy as jnp
 
             schema = plan.output_schema()
             empty = ColumnBatch.from_numpy(
-                schema, {f.name: np.zeros(0, f.dtype.device_dtype())
-                         for f in schema.fields}, capacity=8,
+                schema,
+                {f.name: np.zeros(0, f.dtype.device_dtype())
+                 for f in schema.fields},
+                {f.name: Dictionary([]) for f in schema.fields
+                 if f.dtype.kind == "utf8"},
+                capacity=8,
             )
             stats = ipc.write_partition(path, [empty])
         log.info("executed %s in %.1fs (%d rows)", pid.key(),

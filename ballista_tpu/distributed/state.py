@@ -167,6 +167,38 @@ class SchedulerState:
         # stage dependency bookkeeping: (job, stage) -> [dep stage ids]
         self._stage_deps: Dict[Tuple[str, int], List[int]] = {}
         self._stage_parts: Dict[Tuple[str, int], int] = {}
+        self._rehydrate()
+
+    def _rehydrate(self):
+        """Rebuild in-memory scheduling state from a durable backend after a
+        scheduler restart: stage deps/partition counts from the persisted
+        stage rows, and the ready-queue from tasks that were pending when
+        the previous scheduler died (running tasks are re-queued too — the
+        old executor's completion report would be lost)."""
+        stage_rows = self.kv.get_from_prefix(self._k("stages"))
+        if not stage_rows:
+            return
+        prefix = self._k("stages") + "/"
+        with self._lock:
+            jobs = set()
+            for k, v in stage_rows:
+                job_id, sid = k[len(prefix):].split("/")
+                sid = int(sid)
+                _, nparts, deps = pickle.loads(v)
+                self._stage_deps[(job_id, sid)] = list(deps)
+                self._stage_parts[(job_id, sid)] = nparts
+                jobs.add(job_id)
+            for job_id in jobs:
+                js = self.get_job_status(job_id)
+                if js is not None and js.state in ("completed", "failed"):
+                    continue
+                for sid in self.stage_ids(job_id):
+                    deps = self._stage_deps.get((job_id, sid), [])
+                    if not all(self._stage_complete(job_id, d) for d in deps):
+                        continue
+                    for t in self.get_task_statuses(job_id, sid):
+                        if t.state in (None, "running"):
+                            self._ready.append(t.partition)
 
     # -- keys ---------------------------------------------------------------
 
@@ -229,10 +261,11 @@ class SchedulerState:
 
     def get_task_statuses(self, job_id: str,
                           stage_id: Optional[int] = None) -> List[TaskStatus]:
+        # trailing '/' so stage 1 doesn't prefix-match stages 10..19
         prefix = (
-            self._k("tasks", job_id, stage_id)
+            self._k("tasks", job_id, stage_id) + "/"
             if stage_id is not None
-            else self._k("tasks", job_id)
+            else self._k("tasks", job_id) + "/"
         )
         return [pickle.loads(v) for _, v in self.kv.get_from_prefix(prefix)]
 

@@ -7,6 +7,7 @@ fixed-capacity ColumnBatches with interned per-table dictionaries so
 string comparisons stay ordinal across all partitions.
 """
 
+from .cache import CacheSource  # noqa: F401
 from .memory import MemTableSource  # noqa: F401
 from .text import CsvSource, TblSource  # noqa: F401
 from .parquet import ParquetSource  # noqa: F401

@@ -1,0 +1,34 @@
+"""Caching table source: materialize scanned batches once, serve from
+memory/device afterwards (the Spark ``.cache()`` analogue; the reference
+re-scans files every query, rust/client/src/context.rs:88-108)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..datatypes import Schema
+from ..logical import TableSource
+
+
+class CacheSource(TableSource):
+    def __init__(self, inner: TableSource):
+        self.inner = inner
+        self._cache: Dict[Tuple[int, Optional[Tuple[str, ...]]], list] = {}
+
+    def table_schema(self) -> Schema:
+        return self.inner.table_schema()
+
+    def num_partitions(self) -> int:
+        return self.inner.num_partitions()
+
+    def source_descriptor(self) -> dict:
+        return self.inner.source_descriptor()
+
+    def scan(self, partition: int, projection: Optional[Sequence[str]] = None):
+        key = (partition, tuple(projection) if projection is not None else None)
+        if key not in self._cache:
+            self._cache[key] = list(self.inner.scan(partition, projection))
+        yield from self._cache[key]
+
+    def invalidate(self):
+        self._cache.clear()

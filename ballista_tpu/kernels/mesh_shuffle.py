@@ -1,0 +1,94 @@
+"""ICI all_to_all hash shuffle: the on-device replacement for the host
+shuffle (reference moves whole partitions over Arrow Flight,
+rust/core/src/execution_plans/shuffle_reader.rs:77-99; within a TPU slice
+we exchange rows over ICI instead).
+
+Works inside ``shard_map`` with static shapes:
+
+1. each device computes a destination id per live row (splitmix64 hash of
+   the key mod n_devices);
+2. rows are grouped by destination with a stable sort and scattered into a
+   send buffer [n_dev, dest_capacity] (padded);
+3. one ``lax.all_to_all`` exchanges the buffers;
+4. per-source row counts travel alongside, so the receiver reconstructs a
+   live mask for its [n_dev * dest_capacity] output rows.
+
+``dest_capacity`` bounds rows sent from one device to one destination; the
+caller picks it (conservatively = capacity, or tighter with overflow
+detection via the returned per-destination counts).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .hashing import splitmix64
+
+
+def destination_ids(keys: jax.Array, live: jax.Array, n_dev: int) -> jax.Array:
+    """int32 destination device per row (dead rows -> 0)."""
+    h = splitmix64(keys.astype(jnp.int64))
+    d = (h % jnp.uint64(n_dev)).astype(jnp.int32)
+    return jnp.where(live, d, 0)
+
+
+def all_to_all_rows(
+    columns: Sequence[jax.Array],  # each [N] per-device rows
+    live: jax.Array,  # bool [N]
+    dest: jax.Array,  # int32 [N] in [0, n_dev)
+    axis_name: str,
+    n_dev: int,
+    dest_capacity: int,
+) -> Tuple[List[jax.Array], jax.Array, jax.Array]:
+    """Exchange rows so each lands on its destination device.
+
+    Returns (out_columns each [n_dev*dest_capacity], out_live, send_counts
+    [n_dev] — callers check max(send_counts) <= dest_capacity for overflow).
+    """
+    n = live.shape[0]
+    d = jnp.where(live, dest, n_dev)  # dead rows to trash bucket
+
+    # stable sort rows by destination; rank within destination
+    order = jnp.argsort(d, stable=True)
+    d_sorted = d[order]
+    # rank of each sorted row within its destination run
+    idx = jnp.arange(n, dtype=jnp.int32)
+    first_of_dest = jnp.searchsorted(d_sorted, jnp.arange(n_dev + 1)).astype(
+        jnp.int32
+    )
+    rank = idx - first_of_dest[jnp.minimum(d_sorted, n_dev)]
+    counts = jnp.bincount(jnp.minimum(d, n_dev), length=n_dev + 1)[:n_dev]
+
+    # scatter sorted rows into [n_dev, dest_capacity] send buffers; rows
+    # with no slot (dead / over capacity) get an out-of-bounds index and
+    # are dropped by the scatter
+    slot_ok = jnp.logical_and(d_sorted < n_dev, rank < dest_capacity)
+    oob = n_dev * dest_capacity
+    slot = jnp.where(
+        slot_ok, jnp.minimum(d_sorted, n_dev - 1) * dest_capacity + rank, oob
+    )
+
+    out_cols = []
+    for col in columns:
+        src = col[order]
+        buf = jnp.zeros((n_dev * dest_capacity,), col.dtype)
+        buf = buf.at[slot].set(src, mode="drop")
+        # exchange: [n_dev, cap] -> all_to_all over the mesh axis
+        got = lax.all_to_all(
+            buf.reshape(n_dev, dest_capacity), axis_name, 0, 0, tiled=False
+        )
+        out_cols.append(got.reshape(n_dev * dest_capacity))
+
+    # counts destined to me, from each source device
+    my_counts = lax.all_to_all(
+        jnp.minimum(counts, dest_capacity).reshape(n_dev, 1),
+        axis_name, 0, 0, tiled=False,
+    ).reshape(n_dev)
+    rank_out = jnp.arange(n_dev * dest_capacity, dtype=jnp.int32) % dest_capacity
+    src_of = jnp.arange(n_dev * dest_capacity, dtype=jnp.int32) // dest_capacity
+    out_live = rank_out < my_counts[src_of]
+    return out_cols, out_live, counts

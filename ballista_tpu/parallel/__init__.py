@@ -1,0 +1,13 @@
+"""In-mesh SPMD query execution over `jax.sharding.Mesh`.
+
+This is the TPU-native fast path that replaces host shuffles when producer
+and consumer stages run on chips of one slice: partitions shard over mesh
+devices, pipelines run under ``shard_map`` as one SPMD XLA program, hash
+repartition becomes an ICI ``all_to_all`` (kernels in mesh_shuffle.py), and
+two-phase aggregation merges via ``all_gather`` — the design mapping called
+out in SURVEY §5.7/§5.8 for the reference's Flight-based shuffle
+(reference: rust/executor/src/flight_service.rs, rust/core/src/
+execution_plans/shuffle_reader.rs).
+"""
+
+from .mesh import make_mesh, MeshQueryRunner  # noqa: F401

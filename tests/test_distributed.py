@@ -89,6 +89,44 @@ def test_job_status_machine():
     assert len(js.partition_locations) == 1
 
 
+def test_task_prefix_no_stage_collision():
+    """Stage 1's task prefix must not match stages 10+ (regression)."""
+    st = SchedulerState(MemoryBackend())
+    for sid in (1, 10):
+        st.save_stage_plan("j", sid, b"x", 1, [])
+        st.save_task_status(TaskStatus(PartitionId("j", sid, 0)))
+    st.save_task_status(
+        TaskStatus(PartitionId("j", 10, 0), "completed", executor_id="e",
+                   path="p", stats={})
+    )
+    s1 = st.get_task_statuses("j", 1)
+    assert len(s1) == 1 and s1[0].state is None
+    assert not st._stage_complete("j", 1)
+
+
+def test_sqlite_state_rehydration(tmp_path):
+    """A restarted scheduler must resume pending jobs from sqlite."""
+    db = str(tmp_path / "st.db")
+    st = SchedulerState(SqliteBackend(db))
+    st.save_job_status("jr", JobStatus("queued"))
+    st.save_stage_plan("jr", 1, b"x", 2, [])
+    st.save_stage_plan("jr", 2, b"y", 1, [1])
+    for p in range(2):
+        st.save_task_status(TaskStatus(PartitionId("jr", 1, p)))
+    st.save_task_status(TaskStatus(PartitionId("jr", 2, 0)))
+    st.enqueue_job("jr")
+    t = st.next_task()  # one task taken, scheduler "dies" now
+    st.save_task_status(TaskStatus(t, "running", executor_id="e1"))
+
+    st2 = SchedulerState(SqliteBackend(db))  # restart
+    got = set()
+    while (nt := st2.next_task()) is not None:
+        got.add((nt.stage_id, nt.partition_id))
+    # both stage-1 tasks are runnable again (the running one is requeued —
+    # its executor's completion report died with the old scheduler)
+    assert got == {(1, 0), (1, 1)}
+
+
 def test_failed_task_fails_job():
     st = SchedulerState(MemoryBackend())
     st.save_job_status("j2", JobStatus("queued"))

@@ -1,0 +1,103 @@
+"""Mesh SPMD tests on the virtual 8-device CPU topology: distributed
+two-phase aggregation and the ICI all_to_all hash shuffle."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+
+from ballista_tpu import schema, Int64, Decimal
+from ballista_tpu.columnar import ColumnBatch
+from ballista_tpu.kernels.aggregate import AggInput, grouped_aggregate
+from ballista_tpu.parallel import make_mesh, MeshQueryRunner
+
+N_DEV = 8
+CAP = 256
+
+
+def make_device_batches(seed=0):
+    s = schema(("k", Int64), ("v", Int64))
+    rng = np.random.default_rng(seed)
+    batches = []
+    all_k, all_v = [], []
+    for d in range(N_DEV):
+        n = int(rng.integers(CAP // 2, CAP))
+        k = rng.integers(0, 5, n)
+        v = rng.integers(0, 100, n)
+        all_k.append(k)
+        all_v.append(v)
+        batches.append(
+            ColumnBatch.from_numpy(s, {"k": k, "v": v}, capacity=CAP)
+        )
+    return s, batches, np.concatenate(all_k), np.concatenate(all_v)
+
+
+def test_mesh_two_phase_aggregate(eight_devices):
+    s, batches, gk, gv = make_device_batches()
+    mesh = make_mesh(N_DEV)
+    runner = MeshQueryRunner(mesh)
+    G = 8
+
+    def device_fn(cols, live):
+        # partial aggregate on this device
+        res = grouped_aggregate(
+            [cols["k"]], live,
+            [AggInput("sum", cols["v"], None), AggInput("count", None, None)],
+            G,
+        )
+        keys = jnp.where(res.group_valid,
+                         jnp.take(cols["k"], res.rep_indices), -1)
+        # merge: all_gather partial tables, re-aggregate (replicated)
+        keys_g = lax.all_gather(keys, "data").reshape(-1)
+        sums_g = lax.all_gather(res.aggregates[0], "data").reshape(-1)
+        cnts_g = lax.all_gather(res.aggregates[1], "data").reshape(-1)
+        live_g = keys_g >= 0
+        final = grouped_aggregate(
+            [keys_g], live_g,
+            [AggInput("sum", sums_g, None), AggInput("sum", cnts_g, None)],
+            G,
+        )
+        fk = jnp.where(final.group_valid, jnp.take(keys_g, final.rep_indices), -1)
+        return fk, final.aggregates[0], final.aggregates[1]
+
+    (fk, fs, fc), _ = runner.run_spmd(s, batches, device_fn)
+    fk, fs, fc = np.asarray(fk), np.asarray(fs), np.asarray(fc)
+    got = {int(k): (int(s_), int(c)) for k, s_, c in zip(fk, fs, fc) if k >= 0}
+
+    exp = {}
+    for k in np.unique(gk):
+        m = gk == k
+        exp[int(k)] = (int(gv[m].sum()), int(m.sum()))
+    assert got == exp
+
+
+def test_mesh_all_to_all_shuffle(eight_devices):
+    s, batches, gk, gv = make_device_batches(seed=1)
+    mesh = make_mesh(N_DEV)
+    runner = MeshQueryRunner(mesh)
+    shuffle = runner.shuffle_fn("k", dest_capacity=CAP)
+
+    def device_fn(cols, live):
+        cols2, live2, overflowed = shuffle(cols, live)
+        # after the shuffle every live row on this device must hash here;
+        # verify by computing destination again and summing local stats
+        from ballista_tpu.kernels.mesh_shuffle import destination_ids
+
+        dest2 = destination_ids(cols2["k"], live2, N_DEV)
+        me = lax.axis_index("data")
+        misplaced = jnp.sum(
+            jnp.logical_and(live2, dest2 != me).astype(jnp.int32)
+        )
+        local_sum = jnp.sum(jnp.where(live2, cols2["v"], 0))
+        local_rows = jnp.sum(live2.astype(jnp.int64))
+        return (
+            lax.all_gather(misplaced, "data"),
+            lax.all_gather(local_sum, "data"),
+            lax.all_gather(local_rows, "data"),
+        )
+
+    (mis, sums, rows), _ = runner.run_spmd(s, batches, device_fn)
+    assert int(np.asarray(mis).sum()) == 0, "rows landed on wrong device"
+    assert int(np.asarray(rows).sum()) == len(gk), "rows lost in shuffle"
+    assert int(np.asarray(sums).sum()) == int(gv.sum()), "values corrupted"
