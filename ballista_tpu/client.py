@@ -55,6 +55,10 @@ class BallistaContext:
         self.port = port
         self.settings = dict(settings or {})
         self._catalog: Dict[str, CatalogTable] = {}
+        # SQL plan cache: repeated identical queries reuse the planned (and,
+        # in standalone mode, compiled) DataFrame; invalidated on any
+        # catalog change
+        self._plan_cache: Dict[str, "DataFrame"] = {}
 
     # -- constructors -------------------------------------------------------
 
@@ -77,6 +81,7 @@ class BallistaContext:
             source = CacheSource(source)
         pk = primary_key or _default_pk(source.table_schema())
         self._catalog[name] = CatalogTable(name, source, pk)
+        self._plan_cache.clear()
 
     def register_tbl(self, name: str, path: str, schema: Schema,
                      primary_key: Optional[str] = None, cached: bool = False,
@@ -106,6 +111,7 @@ class BallistaContext:
 
     def deregister_table(self, name: str) -> None:
         self._catalog.pop(name, None)
+        self._plan_cache.clear()
 
     def tables(self) -> List[str]:
         return sorted(self._catalog)
@@ -135,6 +141,9 @@ class BallistaContext:
     # -- SQL ----------------------------------------------------------------
 
     def sql(self, query: str) -> "DataFrame":
+        cached = self._plan_cache.get(query)
+        if cached is not None:
+            return cached
         stmt = parse_sql(query)
         if isinstance(stmt, CreateExternalTable):
             sch = make_schema(*[(n, t) for n, t in stmt.columns])
@@ -149,7 +158,9 @@ class BallistaContext:
                 raise PlanError(f"STORED AS {stmt.stored_as} unsupported")
             return DataFrame(self, None)
         planner = SqlPlanner(self._catalog)
-        return DataFrame(self, planner.plan(stmt))
+        df = DataFrame(self, planner.plan(stmt))
+        self._plan_cache[query] = df
+        return df
 
     # -- execution ----------------------------------------------------------
 

@@ -236,9 +236,8 @@ class ColumnBatch:
                 dicts[f.name] = d
                 arrays[f.name] = codes
             elif f.dtype.kind == "decimal":
-                scale = 10 ** f.dtype.scale
-                arrays[f.name] = np.asarray(
-                    [int(round(float(v) * scale)) for v in vals], dtype=np.int64
+                arrays[f.name] = decimal_to_scaled(
+                    [float(v) for v in vals], f.dtype.scale
                 )
             else:
                 arrays[f.name] = np.asarray(vals, dtype=f.dtype.device_dtype())
@@ -326,6 +325,14 @@ jax.tree_util.register_pytree_node(ColumnBatch, _flatten_batch, _unflatten_batch
 # ---------------------------------------------------------------------------
 # Host-side helpers
 # ---------------------------------------------------------------------------
+
+
+def decimal_to_scaled(values, scale: int) -> np.ndarray:
+    """float/str decimal values -> scaled int64 using HALF-UP (away from
+    zero) rounding — the same rule as the native C++ parser, so results
+    never depend on which scanner read the file."""
+    v = np.asarray(values, dtype=np.float64) * (10 ** scale)
+    return (np.sign(v) * np.floor(np.abs(v) + 0.5)).astype(np.int64)
 
 
 def decode_physical_array(

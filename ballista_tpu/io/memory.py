@@ -34,9 +34,10 @@ class MemTableSource(TableSource):
                 dicts[f.name] = d
                 arrays[f.name] = codes
             elif f.dtype.kind == "decimal":
-                scale = 10 ** f.dtype.scale
-                arrays[f.name] = np.asarray(
-                    [int(round(float(v) * scale)) for v in vals], dtype=np.int64
+                from ..columnar import decimal_to_scaled
+
+                arrays[f.name] = decimal_to_scaled(
+                    [float(v) for v in vals], f.dtype.scale
                 )
             else:
                 arrays[f.name] = np.asarray(vals, dtype=f.dtype.device_dtype())

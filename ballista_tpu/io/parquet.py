@@ -123,9 +123,10 @@ class ParquetSource(TableSource):
                 arrays[name] = codes.astype(np.int32)
                 dicts[name] = d
             elif field.dtype.kind == "decimal":
-                scale = 10 ** field.dtype.scale
+                from ..columnar import decimal_to_scaled
+
                 vals = colarr.cast("float64").to_numpy(zero_copy_only=False)
-                arrays[name] = np.round(vals * scale).astype(np.int64)
+                arrays[name] = decimal_to_scaled(vals, field.dtype.scale)
             elif field.dtype.kind == "date32":
                 arrays[name] = colarr.cast("int32").to_numpy(zero_copy_only=False)
             else:

@@ -103,20 +103,77 @@ class DelimitedSource(TableSource):
         """Global sorted dictionary over all partitions (built once)."""
         if colname in self._dicts:
             return self._dicts[colname]
-        idx = self._schema.index_of(colname)
+        from . import native
+
         uniq: Optional[np.ndarray] = None
         for f in self._files:
-            df = self._read_pandas(f, self._column_names(), [idx])
-            vals = df[colname].astype(str).to_numpy(dtype=object)
-            u = np.unique(vals)
+            if self._use_native():
+                _, _, fd = native.scan_file(
+                    f, self._schema, [colname], self._delim, self._header
+                )
+                u = fd[colname]
+            else:
+                idx = self._schema.index_of(colname)
+                df = self._read_pandas(f, self._column_names(), [idx])
+                u = np.unique(df[colname].astype(str).to_numpy(dtype=object))
             uniq = u if uniq is None else np.unique(np.concatenate([uniq, u]))
         d = Dictionary(uniq if uniq is not None else [])
         self._dicts[colname] = d
         return d
 
+    def _use_native(self) -> bool:
+        # the native scanner does no quote handling; use it only for the
+        # unquoted '|' (TPC-H .tbl) format and keep quoted CSV on pandas
+        from . import native
+
+        return native.available() and self._delim == "|"
+
     def scan(self, partition: int, projection: Optional[Sequence[str]] = None):
         names = projection if projection is not None else self._schema.names()
         sub_schema = self._schema.project(names)
+        if self._use_native():
+            n, arrays, dicts = self._scan_native(partition, names)
+        else:
+            n, arrays, dicts = self._scan_pandas(partition, names)
+        # chunk into fixed-capacity batches
+        yield from self._emit_batches(sub_schema, n, arrays, dicts)
+
+    def _scan_native(self, partition: int, names):
+        """Native C++ scan; per-file utf8 dictionaries are remapped onto the
+        table-wide union dictionary so codes stay ordinal across
+        partitions. Single-file tables adopt the file dictionary directly."""
+        from . import native
+
+        n, arrays, fdicts = native.scan_file(
+            self._files[partition], self._schema, list(names),
+            self._delim, self._header,
+        )
+        dicts: Dict[str, Dictionary] = {}
+        for name in names:
+            if self._schema.field(name).dtype.kind != "utf8":
+                continue
+            fvals = fdicts[name]
+            if len(self._files) == 1:
+                if name not in self._dicts:
+                    self._dicts[name] = Dictionary(fvals)
+                d = self._dicts[name]
+                # same file scanned twice must yield the same dict; remap
+                # defensively if the cached dict came from elsewhere
+                if len(d) != len(fvals) or not np.array_equal(
+                    d.values.astype(str), fvals.astype(str)
+                ):
+                    remap = np.searchsorted(
+                        d.values.astype(str), fvals.astype(str)
+                    )
+                    arrays[name] = remap[arrays[name]].astype(np.int32)
+            else:
+                d = self._dictionary_for(name)
+                remap = np.searchsorted(d.values.astype(str), fvals.astype(str))
+                arrays[name] = remap[arrays[name]].astype(np.int32)
+            dicts[name] = d
+        return n, arrays, dicts
+
+    def _scan_pandas(self, partition: int, names):
         idxs = [self._schema.index_of(n) for n in names]
         df = self._read_pandas(self._files[partition], self._column_names(), idxs)
         n = len(df)
@@ -132,16 +189,19 @@ class DelimitedSource(TableSource):
                 arrays[name] = codes.astype(np.int32)
                 dicts[name] = d
             elif field.dtype.kind == "decimal":
-                scale = 10 ** field.dtype.scale
-                arrays[name] = np.round(
-                    raw.to_numpy(dtype=np.float64) * scale
-                ).astype(np.int64)
+                from ..columnar import decimal_to_scaled
+
+                arrays[name] = decimal_to_scaled(
+                    raw.to_numpy(dtype=np.float64), field.dtype.scale
+                )
             elif field.dtype.kind == "date32":
                 vals = raw.astype(str).to_numpy(dtype="datetime64[D]")
                 arrays[name] = vals.astype(np.int32)
             else:
                 arrays[name] = raw.to_numpy(dtype=field.dtype.device_dtype())
-        # chunk into fixed-capacity batches
+        return n, arrays, dicts
+
+    def _emit_batches(self, sub_schema, n, arrays, dicts):
         cap = min(self._capacity, round_capacity(max(n, 1)))
         start = 0
         emitted = False

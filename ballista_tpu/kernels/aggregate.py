@@ -186,6 +186,60 @@ def _min_ident(dt):
 
 
 # ---------------------------------------------------------------------------
+# Dense grouping fast path: group ids already small dense ints (dictionary
+# codes / booleans with known cardinality). No sort — one fused masked
+# reduction per aggregate, which is the MXU/VPU-friendly shape for TPC-H
+# q1-style tiny-cardinality GROUP BYs.
+# ---------------------------------------------------------------------------
+
+
+def dense_grouped_aggregate(
+    gids: jax.Array,  # int32 [N] in [0, num_groups)
+    live: jax.Array,  # bool [N]
+    aggs: Sequence[AggInput],
+    num_groups: int,
+) -> GroupedResult:
+    n = gids.shape[0]
+    groups = jnp.arange(num_groups, dtype=jnp.int32)
+    # [N, G] membership mask, fused into each reduction (never materialized
+    # at full width for small G)
+    member = jnp.logical_and(live[:, None], gids[:, None] == groups[None, :])
+
+    group_valid = jnp.any(member, axis=0)
+    # argmax returns the FIRST True row per group
+    rep_indices = jnp.argmax(member, axis=0).astype(jnp.int32)
+    num_present = jnp.sum(group_valid.astype(jnp.int32))
+
+    results: List[jax.Array] = []
+    valid_results: List[jax.Array] = []
+    for a in aggs:
+        m = member
+        if a.validity is not None:
+            m = jnp.logical_and(m, a.validity[:, None])
+        if a.op == "count":
+            r = jnp.sum(m.astype(jnp.int64), axis=0)
+            va = group_valid
+        else:
+            if a.values is None:
+                raise ExecutionError(f"{a.op} requires input values")
+            v = a.values[:, None]
+            if a.op == "sum":
+                r = jnp.sum(jnp.where(m, v, jnp.zeros((), v.dtype)), axis=0)
+            elif a.op == "min":
+                r = jnp.min(jnp.where(m, v, _max_ident(v.dtype)), axis=0)
+            elif a.op == "max":
+                r = jnp.max(jnp.where(m, v, _min_ident(v.dtype)), axis=0)
+            else:
+                raise ExecutionError(f"unknown aggregate op {a.op}")
+            va = jnp.any(m, axis=0)
+        results.append(jnp.where(va, r, jnp.zeros((), r.dtype)))
+        valid_results.append(va)
+
+    return GroupedResult(rep_indices, group_valid, num_present, results,
+                         valid_results)
+
+
+# ---------------------------------------------------------------------------
 # Ungrouped aggregation (whole-batch reductions)
 # ---------------------------------------------------------------------------
 

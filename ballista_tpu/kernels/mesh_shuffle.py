@@ -26,14 +26,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .hashing import splitmix64
+from .hashing import hash_partition_ids, splitmix64
 
 
 def destination_ids(keys: jax.Array, live: jax.Array, n_dev: int) -> jax.Array:
-    """int32 destination device per row (dead rows -> 0)."""
-    h = splitmix64(keys.astype(jnp.int64))
-    d = (h % jnp.uint64(n_dev)).astype(jnp.int32)
-    return jnp.where(live, d, 0)
+    """int32 destination device per row (dead rows -> 0). Shares the
+    partitioning hash with the host shuffle (hashing.hash_partition_ids) so
+    both planes always agree on row destinations."""
+    return jnp.where(live, hash_partition_ids(keys.astype(jnp.int64), n_dev), 0)
 
 
 def all_to_all_rows(

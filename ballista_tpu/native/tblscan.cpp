@@ -1,0 +1,309 @@
+// Native delimited-text scanner for ballista-tpu.
+//
+// The role DataFusion's Rust CSV reader plays for the reference engine's
+// scans (reference: rust/client/src/context.rs:88-108 read_csv;
+// rust/benchmarks/tpch/src/main.rs:128-155 .tbl registration): parse
+// '|'/','-delimited files into typed columnar buffers at native speed.
+//
+// Exposed as a C API consumed from Python via ctypes (no pybind11 in the
+// build environment). One pass over an mmap'd file; per-column typed
+// vectors; string columns are dictionary-encoded with a SORTED dictionary
+// so codes are ordinal (the engine's comparison kernels rely on this).
+//
+// Column kinds: 0=int64 1=int32 2=decimal(scale)->int64 3=date32(days)
+//               4=utf8 dict codes (int32) 5=float32 6=boolean(int32)
+//               -1 = skip column.
+// NOTE: no quote handling — callers route quoted CSV through the Python
+// reader and use this scanner for unquoted formats (TPC-H .tbl).
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Column {
+  int kind = -1;
+  int scale = 0;
+  std::vector<int64_t> i64;
+  std::vector<int32_t> i32;
+  std::vector<float> f32;
+  // utf8: raw codes (pre-sort), dictionary arena
+  std::unordered_map<std::string, int32_t> dict_map;
+  std::vector<std::string> dict_values;
+};
+
+struct Table {
+  std::vector<Column> cols;
+  int64_t num_rows = 0;
+  std::string error;
+};
+
+inline int64_t days_from_civil(int y, int m, int d) {
+  // Howard Hinnant's civil-days algorithm (public domain)
+  y -= m <= 2;
+  const int era = (y >= 0 ? y : y - 399) / 400;
+  const unsigned yoe = static_cast<unsigned>(y - era * 400);
+  const unsigned doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  const unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  return era * 146097LL + static_cast<int>(doe) - 719468;
+}
+
+inline int64_t pow10_i(int n) {
+  int64_t p = 1;
+  while (n-- > 0) p *= 10;
+  return p;
+}
+
+// parse one field [s, e) into column c
+inline bool parse_field(Column& c, const char* s, const char* e) {
+  switch (c.kind) {
+    case 0: case 1: {  // int64 / int32
+      bool neg = false;
+      if (s < e && (*s == '-' || *s == '+')) neg = (*s == '-'), ++s;
+      int64_t v = 0;
+      for (; s < e; ++s) {
+        if (*s < '0' || *s > '9') return false;
+        v = v * 10 + (*s - '0');
+      }
+      if (neg) v = -v;
+      if (c.kind == 0) c.i64.push_back(v);
+      else c.i32.push_back(static_cast<int32_t>(v));
+      return true;
+    }
+    case 2: {  // decimal -> scaled int64
+      bool neg = false;
+      if (s < e && (*s == '-' || *s == '+')) neg = (*s == '-'), ++s;
+      int64_t ip = 0;
+      for (; s < e && *s != '.'; ++s) {
+        if (*s < '0' || *s > '9') return false;
+        ip = ip * 10 + (*s - '0');
+      }
+      int64_t fp = 0;
+      int fdigits = 0;
+      if (s < e && *s == '.') {
+        ++s;
+        for (; s < e && fdigits < c.scale; ++s, ++fdigits) {
+          if (*s < '0' || *s > '9') return false;
+          fp = fp * 10 + (*s - '0');
+        }
+        // round on the first truncated digit
+        if (s < e && *s >= '5' && *s <= '9') ++fp;
+      }
+      while (fdigits < c.scale) fp *= 10, ++fdigits;
+      int64_t v = ip * pow10_i(c.scale) + fp;
+      c.i64.push_back(neg ? -v : v);
+      return true;
+    }
+    case 3: {  // date32: YYYY-MM-DD
+      if (e - s < 10) return false;
+      auto num = [&](const char* p, int n) {
+        int v = 0;
+        for (int i = 0; i < n; ++i) v = v * 10 + (p[i] - '0');
+        return v;
+      };
+      int y = num(s, 4), m = num(s + 5, 2), d = num(s + 8, 2);
+      c.i32.push_back(static_cast<int32_t>(days_from_civil(y, m, d)));
+      return true;
+    }
+    case 4: {  // utf8 dict
+      std::string key(s, static_cast<size_t>(e - s));
+      auto it = c.dict_map.find(key);
+      int32_t code;
+      if (it == c.dict_map.end()) {
+        code = static_cast<int32_t>(c.dict_values.size());
+        c.dict_map.emplace(key, code);
+        c.dict_values.push_back(std::move(key));
+      } else {
+        code = it->second;
+      }
+      c.i32.push_back(code);
+      return true;
+    }
+    case 5: {  // float32
+      char buf[64];
+      size_t n = std::min<size_t>(static_cast<size_t>(e - s), 63);
+      memcpy(buf, s, n);
+      buf[n] = 0;
+      c.f32.push_back(strtof(buf, nullptr));
+      return true;
+    }
+    case 6: {  // boolean: true/false/t/f/1/0 (case-insensitive)
+      char c0 = (s < e) ? static_cast<char>(tolower(*s)) : 0;
+      if (c0 == 't' || c0 == '1') c.i32.push_back(1);
+      else if (c0 == 'f' || c0 == '0') c.i32.push_back(0);
+      else return false;
+      return true;
+    }
+    default:
+      return true;  // skipped column
+  }
+}
+
+void sort_dictionary(Column& c) {
+  // sort dict; remap codes so they stay ordinal
+  const size_t n = c.dict_values.size();
+  std::vector<int32_t> order(n);
+  for (size_t i = 0; i < n; ++i) order[i] = static_cast<int32_t>(i);
+  std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+    return c.dict_values[a] < c.dict_values[b];
+  });
+  std::vector<int32_t> remap(n);
+  std::vector<std::string> sorted(n);
+  for (size_t i = 0; i < n; ++i) {
+    remap[order[i]] = static_cast<int32_t>(i);
+    sorted[i] = std::move(c.dict_values[order[i]]);
+  }
+  c.dict_values = std::move(sorted);
+  for (auto& code : c.i32) code = remap[code];
+  c.dict_map.clear();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque Table*; on fatal error returns a Table with error set
+// (check tbl_error). wanted: indices of columns to materialize; others are
+// parsed-past. delimiter: e.g. '|'; skip_header: 1 to drop first line.
+void* tbl_open(const char* path, int ncols, const int32_t* kinds,
+               const int32_t* scales, const int32_t* wanted, int nwanted,
+               char delimiter, int skip_header) {
+  auto* t = new Table();
+  t->cols.resize(static_cast<size_t>(ncols));
+  std::vector<char> want(static_cast<size_t>(ncols), 0);
+  for (int i = 0; i < nwanted; ++i) want[static_cast<size_t>(wanted[i])] = 1;
+  for (int i = 0; i < ncols; ++i) {
+    t->cols[static_cast<size_t>(i)].kind = want[static_cast<size_t>(i)] ? kinds[i] : -1;
+    t->cols[static_cast<size_t>(i)].scale = scales[i];
+  }
+
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) {
+    t->error = std::string("open failed: ") + strerror(errno);
+    return t;
+  }
+  struct stat st;
+  fstat(fd, &st);
+  size_t size = static_cast<size_t>(st.st_size);
+  if (size == 0) {
+    close(fd);
+    return t;
+  }
+  const char* data = static_cast<const char*>(
+      mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0));
+  close(fd);
+  if (data == MAP_FAILED) {
+    t->error = std::string("mmap failed: ") + strerror(errno);
+    return t;
+  }
+
+  const char* p = data;
+  const char* end = data + size;
+  if (skip_header) {
+    while (p < end && *p != '\n') ++p;
+    if (p < end) ++p;
+  }
+  const char delim = delimiter;
+  int64_t row = 0;
+  while (p < end) {
+    if (*p == '\n') {  // empty line
+      ++p;
+      continue;
+    }
+    for (int ci = 0; ci < ncols; ++ci) {
+      const char* fs = p;
+      while (p < end && *p != delim && *p != '\n') ++p;
+      Column& c = t->cols[static_cast<size_t>(ci)];
+      if (c.kind >= 0 && !parse_field(c, fs, p)) {
+        char msg[160];
+        snprintf(msg, sizeof msg,
+                 "parse error at row %lld col %d (kind %d)",
+                 static_cast<long long>(row), ci, c.kind);
+        t->error = msg;
+        munmap(const_cast<char*>(data), size);
+        return t;
+      }
+      if (p < end && *p == delim) ++p;  // consume field delimiter
+    }
+    // consume trailing delimiter/garbage to end of line
+    while (p < end && *p != '\n') ++p;
+    if (p < end) ++p;
+    ++row;
+  }
+  munmap(const_cast<char*>(data), size);
+  t->num_rows = row;
+  for (auto& c : t->cols)
+    if (c.kind == 4) sort_dictionary(c);
+  return t;
+}
+
+const char* tbl_error(void* h) {
+  auto* t = static_cast<Table*>(h);
+  return t->error.empty() ? nullptr : t->error.c_str();
+}
+
+int64_t tbl_num_rows(void* h) { return static_cast<Table*>(h)->num_rows; }
+
+// fill int64 buffer (kind 0 and 2)
+int tbl_fill_i64(void* h, int col, int64_t* out) {
+  auto& c = static_cast<Table*>(h)->cols[static_cast<size_t>(col)];
+  if (c.i64.empty() && static_cast<Table*>(h)->num_rows > 0) return -1;
+  memcpy(out, c.i64.data(), c.i64.size() * sizeof(int64_t));
+  return 0;
+}
+
+// fill int32 buffer (kinds 1, 3, 4)
+int tbl_fill_i32(void* h, int col, int32_t* out) {
+  auto& c = static_cast<Table*>(h)->cols[static_cast<size_t>(col)];
+  if (c.i32.empty() && static_cast<Table*>(h)->num_rows > 0) return -1;
+  memcpy(out, c.i32.data(), c.i32.size() * sizeof(int32_t));
+  return 0;
+}
+
+int tbl_fill_f32(void* h, int col, float* out) {
+  auto& c = static_cast<Table*>(h)->cols[static_cast<size_t>(col)];
+  if (c.f32.empty() && static_cast<Table*>(h)->num_rows > 0) return -1;
+  memcpy(out, c.f32.data(), c.f32.size() * sizeof(float));
+  return 0;
+}
+
+int64_t tbl_dict_count(void* h, int col) {
+  return static_cast<int64_t>(
+      static_cast<Table*>(h)->cols[static_cast<size_t>(col)].dict_values.size());
+}
+
+int64_t tbl_dict_total_bytes(void* h, int col) {
+  int64_t n = 0;
+  for (auto& s :
+       static_cast<Table*>(h)->cols[static_cast<size_t>(col)].dict_values)
+    n += static_cast<int64_t>(s.size());
+  return n;
+}
+
+// out: concatenated utf8 bytes; offsets: dict_count+1 entries
+int tbl_fill_dict(void* h, int col, char* out, int64_t* offsets) {
+  auto& c = static_cast<Table*>(h)->cols[static_cast<size_t>(col)];
+  int64_t off = 0;
+  size_t i = 0;
+  for (auto& s : c.dict_values) {
+    offsets[i++] = off;
+    memcpy(out + off, s.data(), s.size());
+    off += static_cast<int64_t>(s.size());
+  }
+  offsets[i] = off;
+  return 0;
+}
+
+void tbl_close(void* h) { delete static_cast<Table*>(h); }
+
+}  // extern "C"

@@ -28,9 +28,14 @@ from .. import expr as ex
 from ..kernels.aggregate import (
     AggInput,
     avg_fixed,
+    dense_grouped_aggregate,
     grouped_aggregate,
     scalar_aggregate,
 )
+
+# dictionary-coded group keys with product-of-cardinalities at or below
+# this use the sort-free dense path
+DENSE_GROUP_LIMIT = 256
 from ..kernels.expr_eval import Evaluator
 from .base import PhysicalPlan, Partitioning, concat_batches
 
@@ -215,6 +220,41 @@ class HashAggregateExec(PhysicalPlan):
             return v.astype(jnp.int64)
         return v.astype(jnp.float32)
 
+    def _run_grouping(self, batch: ColumnBatch, key_evals, aggs, cap):
+        """Pick dense (sort-free) or sort-based grouping. Traced."""
+        cards = []
+        for r in key_evals:
+            if r.dictionary is not None:
+                cards.append(len(r.dictionary))
+            elif r.dtype.kind == "boolean":
+                cards.append(2)
+            else:
+                cards = None
+                break
+        if cards is not None:
+            g_total = 1
+            for r, card in zip(key_evals, cards):
+                g_total *= card + (1 if r.validity is not None else 0)
+            if 0 < g_total <= min(DENSE_GROUP_LIMIT, cap):
+                gid = jnp.zeros((batch.capacity,), jnp.int32)
+                for r, card in zip(key_evals, cards):
+                    slots = card + (1 if r.validity is not None else 0)
+                    code = jnp.broadcast_to(
+                        r.values.astype(jnp.int32), (batch.capacity,)
+                    )
+                    if r.validity is not None:
+                        # NULL keys take the extra slot per key column
+                        code = jnp.where(r.validity, code, card)
+                    gid = gid * slots + code
+                return dense_grouped_aggregate(gid, batch.selection, aggs,
+                                               g_total)
+        keys = [
+            jnp.broadcast_to(r.values, (batch.capacity,)) for r in key_evals
+        ]
+        key_validities = [r.validity for r in key_evals]
+        return grouped_aggregate(keys, batch.selection, aggs, cap,
+                                 key_validities)
+
     def _exec_grouped(self, batch: ColumnBatch) -> ColumnBatch:
         cap = self.group_capacity
         while True:
@@ -239,14 +279,7 @@ class HashAggregateExec(PhysicalPlan):
                         for e in self.group_exprs
                     ]
                     aggs = self._agg_inputs_final(batch)
-                keys = [
-                    jnp.broadcast_to(r.values, (batch.capacity,))
-                    for r in key_evals
-                ]
-                key_validities = [r.validity for r in key_evals]
-                res = grouped_aggregate(
-                    keys, batch.selection, aggs, cap, key_validities
-                )
+                res = self._run_grouping(batch, key_evals, aggs, cap)
                 out_cols: List[Column] = []
                 gf = self.group_fields()
                 for f, r in zip(gf, key_evals):

@@ -92,7 +92,6 @@ def main() -> None:
                           primary_key=TPCH_PKS["lineitem"])
     cold_warmup, out = run_once(ctx_cold)  # includes compile
     cold_s, _ = run_once(ctx_cold)
-    n_rows = int(out["count_order"].sum())
 
     # -- warm: device-resident cached table + prepared (pre-compiled) query -
     ctx = BallistaContext.standalone()
