@@ -63,6 +63,9 @@ def remote_collect(host: str, port: int, logical_plan,
     from ..io import ipc
     from ..columnar import concat_pydicts
 
+    from ..execution import resolve_scalar_subqueries
+
+    logical_plan = resolve_scalar_subqueries(logical_plan)
     job_id = submit_plan(host, port, logical_plan, settings)
     result = wait_for_job(host, port, job_id, timeout)
 

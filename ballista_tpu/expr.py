@@ -433,6 +433,62 @@ class Like(Expr):
 
 
 # ---------------------------------------------------------------------------
+# Subquery expressions (resolved/decorrelated by the SQL planner; a
+# ScalarSubquery that survives to execution is inlined to a Literal by
+# execution.resolve_subqueries)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(repr=False, eq=False)
+class ScalarSubquery(Expr):
+    """(SELECT single_value ...) used as a scalar."""
+
+    plan: object  # LogicalPlan (late-bound by the SQL planner)
+    query: object = None  # parser AST before planning
+
+    def name(self) -> str:
+        return "(<scalar subquery>)"
+
+    def to_field(self, schema: Schema) -> Field:
+        sub_schema = self.plan.schema()
+        f = sub_schema.fields[0]
+        return Field(self.name(), f.dtype, True)
+
+
+@dataclass(repr=False, eq=False)
+class Exists(Expr):
+    """EXISTS (SELECT ...); planner decorrelates into a semi/anti join."""
+
+    query: object  # parser Query AST
+    negated: bool = False
+
+    def name(self) -> str:
+        return ("NOT " if self.negated else "") + "EXISTS(<subquery>)"
+
+    def to_field(self, schema: Schema) -> Field:
+        return Field(self.name(), Boolean, False)
+
+
+@dataclass(repr=False, eq=False)
+class InSubquery(Expr):
+    """expr [NOT] IN (SELECT col ...); planner turns into semi/anti join."""
+
+    expr: Expr
+    query: object  # parser Query AST
+    negated: bool = False
+
+    def name(self) -> str:
+        n = "NOT IN" if self.negated else "IN"
+        return f"{self.expr.name()} {n} (<subquery>)"
+
+    def children(self) -> List[Expr]:
+        return [self.expr]
+
+    def to_field(self, schema: Schema) -> Field:
+        return Field(self.name(), Boolean, True)
+
+
+# ---------------------------------------------------------------------------
 # Scalar functions
 # ---------------------------------------------------------------------------
 

@@ -214,6 +214,9 @@ class Join(LogicalPlan):
     right: LogicalPlan
     on: List[Tuple[str, str]]  # (left_col, right_col)
     how: str = "inner"
+    # SQL NOT IN lowering: anti join where any NULL build key empties the
+    # result and NULL probe keys are excluded
+    null_aware: bool = False
 
     def __post_init__(self):
         if self.how not in JOIN_TYPES:

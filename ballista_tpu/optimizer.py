@@ -56,6 +56,46 @@ def conjoin(parts: List[ex.Expr]) -> ex.Expr:
     return out
 
 
+def split_disjuncts(e: ex.Expr) -> List[ex.Expr]:
+    if isinstance(e, ex.BinaryExpr) and e.op == "or":
+        return split_disjuncts(e.left) + split_disjuncts(e.right)
+    return [e]
+
+
+def factor_or(e: ex.Expr) -> List[ex.Expr]:
+    """(A and X) or (A and Y) -> [A, (X or Y)].
+
+    Pulls conjuncts common to every OR branch to the top (matched by
+    display name). TPC-H q19's OR-of-ANDs hides its join condition this
+    way; factoring exposes it to the join-graph extractor.
+    """
+    branches = split_disjuncts(e)
+    if len(branches) < 2:
+        return [e]
+    branch_sets = [
+        {c.name(): c for c in split_conjuncts(b)} for b in branches
+    ]
+    common_names = set(branch_sets[0])
+    for s in branch_sets[1:]:
+        common_names &= set(s)
+    if not common_names:
+        return [e]
+    out: List[ex.Expr] = [branch_sets[0][n] for n in sorted(common_names)]
+    residuals = []
+    for s in branch_sets:
+        rest = [c for n, c in s.items() if n not in common_names]
+        if not rest:
+            # a branch with no residual makes the OR vacuous beyond the
+            # common part
+            return out
+        residuals.append(conjoin(rest))
+    ored = residuals[0]
+    for r in residuals[1:]:
+        ored = ex.BinaryExpr(ored, "or", r)
+    out.append(ored)
+    return out
+
+
 def push_filters(plan: LogicalPlan) -> LogicalPlan:
     if isinstance(plan, Filter):
         child = push_filters(plan.input)

@@ -42,17 +42,55 @@ class JoinExec(PhysicalPlan):
         probe: PhysicalPlan,
         on: List[Tuple[str, str]],  # (build_col, probe_col)
         how: str = "inner",
+        null_aware: bool = False,
     ):
         if how not in JOIN_TYPES:
             raise NotImplementedError_(f"join type {how}")
-        if len(on) != 1:
-            raise NotImplementedError_("multi-column join keys (round 2)")
+        if not 1 <= len(on) <= 2:
+            raise NotImplementedError_("joins support 1-2 key columns")
         self.build = build
         self.probe = probe
         self.on = list(on)
         self.how = how
-        self._build_data = None  # (BuildTable, build_batch, unique)
+        self.null_aware = null_aware  # SQL NOT IN anti-join semantics
+        self._build_data = None  # (BuildTable, build_batch, unique, has_null)
         self._jit_probe = {}
+
+    # -- composite keys ------------------------------------------------------
+
+    def _key_of(self, batch: ColumnBatch, cols: List[str]):
+        """(int64 key, live-mask-extension). Two-column keys pack as
+        (a << 32) | b — exact for the 31/32-bit key ranges checked in
+        _check_key_ranges."""
+        first = batch.column(cols[0])
+        keys = first.values.astype(jnp.int64)
+        live_ext = first.validity
+        if len(cols) == 2:
+            second = batch.column(cols[1])
+            keys = (keys << 32) | (second.values.astype(jnp.int64)
+                                   & jnp.int64(0xFFFFFFFF))
+            if second.validity is not None:
+                live_ext = (
+                    second.validity if live_ext is None
+                    else jnp.logical_and(live_ext, second.validity)
+                )
+        return keys, live_ext
+
+    def _check_key_ranges(self, batch: ColumnBatch, cols: List[str]):
+        if len(cols) != 2:
+            return
+        import numpy as np
+
+        a = np.asarray(batch.column(cols[0]).values)
+        b = np.asarray(batch.column(cols[1]).values)
+        sel = np.asarray(batch.selection)
+        if sel.any():
+            if (np.abs(a[sel]) >= (1 << 31)).any() or (b[sel] < 0).any() \
+                    or (b[sel] >= (1 << 32) - 1).any():
+                raise ExecutionError(
+                    f"composite join keys {cols} exceed the packable 31/32-bit "
+                    "range"
+                )
 
     # -- schema -------------------------------------------------------------
 
@@ -73,7 +111,8 @@ class JoinExec(PhysicalPlan):
         return [self.build, self.probe]
 
     def with_new_children(self, children):
-        return JoinExec(children[0], children[1], self.on, self.how)
+        return JoinExec(children[0], children[1], self.on, self.how,
+                        self.null_aware)
 
     def display(self) -> str:
         on = ", ".join(f"{l}={r}" for l, r in self.on)
@@ -91,38 +130,56 @@ class JoinExec(PhysicalPlan):
         if not batches:
             raise ExecutionError("join build side produced no batches")
         bb = concat_batches(self.build.output_schema(), batches)
-        bkey_col = bb.column(self.on[0][0])
-        keys = bkey_col.values.astype(jnp.int64)
+        bcols = [b for b, _ in self.on]
+        self._check_key_ranges(bb, bcols)
+        keys, live_ext = self._key_of(bb, bcols)
         live = bb.selection
-        if bkey_col.validity is not None:
-            live = jnp.logical_and(live, bkey_col.validity)
+        has_null_key = False
+        if live_ext is not None:
+            has_null_key = bool(
+                np.any(np.asarray(bb.selection) & ~np.asarray(live_ext))
+            )
+            live = jnp.logical_and(live, live_ext)
         table = jax.jit(join_k.build_lookup)(keys, live)
         sk = np.asarray(table.sorted_keys)
         nlive = int(table.num_live)
         unique = not bool(np.any(sk[1 : nlive] == sk[: nlive - 1])) if nlive > 1 else True
-        self._build_data = (table, bb, unique)
+        self._build_data = (table, bb, unique, has_null_key)
         return self._build_data
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
-        table, build_batch, unique = self._materialize_build()
+        table, build_batch, unique, has_null_key = self._materialize_build()
+        if self.how == "anti" and self.null_aware and has_null_key:
+            # SQL NOT IN with a NULL in the subquery: predicate is never
+            # true -> empty result
+            for pb in self.probe.execute(partition):
+                yield pb.with_selection(
+                    jnp.zeros((pb.capacity,), jnp.bool_)
+                )
+            return
+        pcols = [p for _, p in self.on]
         for pb in self.probe.execute(partition):
+            self._check_key_ranges(pb, pcols)
             if unique:
                 yield self._probe_unique_batch(table, build_batch, pb)
             else:
-                yield self._probe_expand_batch(table, build_batch, pb)
+                yield from self._probe_expand_batch(table, build_batch, pb)
 
     # fast path: unique build keys ------------------------------------------
+
+    def _probe_keys(self, pb: ColumnBatch):
+        pkeys, live_ext = self._key_of(pb, [p for _, p in self.on])
+        plive = pb.selection
+        if live_ext is not None:
+            plive = jnp.logical_and(plive, live_ext)
+        return pkeys, plive
 
     def _probe_unique_batch(self, table, build_batch, pb: ColumnBatch) -> ColumnBatch:
         key = ("u", pb.capacity, build_batch.capacity)
         if key not in self._jit_probe:
 
             def run(table, bb: ColumnBatch, pb: ColumnBatch) -> ColumnBatch:
-                pkey_col = pb.column(self.on[0][1])
-                pkeys = pkey_col.values.astype(jnp.int64)
-                plive = pb.selection
-                if pkey_col.validity is not None:
-                    plive = jnp.logical_and(plive, pkey_col.validity)
+                pkeys, plive = self._probe_keys(pb)
                 build_rows, matched = join_k.probe_unique(table, pkeys, plive)
                 return self._assemble(bb, pb, build_rows, matched,
                                       pb.selection, None)
@@ -132,22 +189,23 @@ class JoinExec(PhysicalPlan):
 
     # general path: expanding probe -----------------------------------------
 
-    def _probe_expand_batch(self, table, build_batch, pb: ColumnBatch) -> ColumnBatch:
-        if self.how != "inner":
+    def _probe_expand_batch(self, table, build_batch,
+                            pb: ColumnBatch) -> Iterator[ColumnBatch]:
+        if self.how not in ("inner", "left", "semi", "anti"):
             raise NotImplementedError_(
-                f"{self.how} join with duplicate build keys (round 2)"
+                f"{self.how} join with duplicate build keys"
             )
+        if self.how in ("semi", "anti"):
+            # membership only: unique probe works regardless of build dups
+            yield self._probe_unique_batch(table, build_batch, pb)
+            return
         out_cap = pb.capacity
         while True:
             key = ("e", pb.capacity, build_batch.capacity, out_cap)
             if key not in self._jit_probe:
 
                 def run(table, bb, pb, _cap=out_cap):
-                    pkey_col = pb.column(self.on[0][1])
-                    pkeys = pkey_col.values.astype(jnp.int64)
-                    plive = pb.selection
-                    if pkey_col.validity is not None:
-                        plive = jnp.logical_and(plive, pkey_col.validity)
+                    pkeys, plive = self._probe_keys(pb)
                     prows, brows, olive, total = join_k.probe_expand(
                         table, pkeys, plive, _cap
                     )
@@ -158,8 +216,28 @@ class JoinExec(PhysicalPlan):
             out, total = self._jit_probe[key](table, build_batch, pb)
             t = int(total)
             if t <= out_cap:
-                return out
+                break
             out_cap = round_capacity(t)
+        yield out
+        if self.how == "left":
+            # preserved probe rows with no match, null build columns
+            key = ("l", pb.capacity, build_batch.capacity)
+            if key not in self._jit_probe:
+
+                def run_unmatched(table, bb, pb):
+                    pkeys, plive = self._probe_keys(pb)
+                    counts = join_k.probe_counts(table, pkeys)
+                    unmatched = jnp.logical_and(pb.selection,
+                                                jnp.logical_or(
+                                                    jnp.logical_not(plive),
+                                                    counts == 0))
+                    zero = jnp.zeros((pb.capacity,), jnp.int32)
+                    no_match = jnp.zeros((pb.capacity,), jnp.bool_)
+                    return self._assemble(bb, pb, zero, no_match, unmatched,
+                                          None)
+
+                self._jit_probe[key] = jax.jit(run_unmatched)
+            yield self._jit_probe[key](table, build_batch, pb)
 
     # assembly --------------------------------------------------------------
 
@@ -171,6 +249,12 @@ class JoinExec(PhysicalPlan):
             return pb.with_selection(sel)
         if self.how == "anti":
             sel = jnp.logical_and(probe_sel, jnp.logical_not(matched))
+            if self.null_aware:
+                # NULL NOT IN (...) is unknown, not true: drop null keys
+                for _, pcol in self.on:
+                    v = pb.column(pcol).validity
+                    if v is not None:
+                        sel = jnp.logical_and(sel, v)
             return pb.with_selection(sel)
         if self.how == "inner":
             sel = jnp.logical_and(probe_sel, matched)

@@ -94,7 +94,8 @@ def create_physical_plan(plan: LogicalPlan) -> PhysicalPlan:
             raise NotImplementedError_(f"join type {plan.how}")
         if build.output_partitioning().num_partitions > 1:
             build = MergeExec(build)
-        joined: PhysicalPlan = JoinExec(build, probe, on, how)
+        joined: PhysicalPlan = JoinExec(build, probe, on, how,
+                                        null_aware=plan.null_aware)
         # restore logical column order if the physical (build-first) order
         # differs (e.g. preserved-left joins probe the left side)
         want = plan.schema().names()

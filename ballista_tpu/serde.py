@@ -275,6 +275,7 @@ def plan_to_proto(plan: lp.LogicalPlan) -> pb.LogicalPlanNode:
             o.left_col = l
             o.right_col = r
         n.join.how = plan.how
+        n.join.null_aware = plan.null_aware
     elif isinstance(plan, lp.Sort):
         n.sort.input.CopyFrom(plan_to_proto(plan.input))
         for e in plan.sort_exprs:
@@ -321,6 +322,7 @@ def plan_from_proto(n: pb.LogicalPlanNode) -> lp.LogicalPlan:
             plan_from_proto(n.join.right),
             [(o.left_col, o.right_col) for o in n.join.on],
             n.join.how,
+            n.join.null_aware,
         )
     if kind == "sort":
         return lp.Sort(
@@ -382,6 +384,7 @@ def physical_to_proto(plan) -> pb.PhysicalPlanNode:
             o.left_col = l
             o.right_col = r
         n.join.how = plan.how
+        n.join.null_aware = plan.null_aware
     elif isinstance(plan, ops.SortExec):
         n.sort.input.CopyFrom(physical_to_proto(plan.child))
         for e in plan.sort_exprs:
@@ -448,6 +451,7 @@ def physical_from_proto(n: pb.PhysicalPlanNode):
             physical_from_proto(n.join.probe),
             [(o.left_col, o.right_col) for o in n.join.on],
             n.join.how,
+            null_aware=n.join.null_aware,
         )
     if kind == "sort":
         return ops.SortExec(

@@ -30,6 +30,7 @@ class SelectItem:
 class TableRef:
     name: str
     alias: Optional[str] = None
+    subquery: Optional["Query"] = None  # derived table: FROM (SELECT ...) a
 
 
 @dataclass
@@ -252,6 +253,17 @@ class Parser:
         return None
 
     def parse_table_ref(self) -> TableRef:
+        if self.accept_op("("):
+            sub = self.parse_query()
+            self.expect_op(")")
+            alias = None
+            if self.accept_kw("as"):
+                alias = self.expect_ident()
+            elif self.peek().kind == "ident":
+                alias = self.next().value
+            if alias is None:
+                raise SqlError("derived table requires an alias")
+            return TableRef(f"__subquery_{alias}", alias, subquery=sub)
         name = self.expect_ident()
         alias = None
         if self.accept_kw("as"):
@@ -337,6 +349,11 @@ class Parser:
             if t.is_kw("in"):
                 self.next()
                 self.expect_op("(")
+                if self.peek().is_kw("select"):
+                    sub = self.parse_query()
+                    self.expect_op(")")
+                    e = ex.InSubquery(e, sub, negated)
+                    continue
                 vals = [self.parse_expr()]
                 while self.accept_op(","):
                     vals.append(self.parse_expr())
@@ -458,6 +475,12 @@ class Parser:
             if unit == "year":
                 return _IntervalMonths(12 * n)
             raise SqlError(f"unsupported interval unit {unit}")
+        if t.is_kw("exists"):
+            self.next()
+            self.expect_op("(")
+            sub = self.parse_query()
+            self.expect_op(")")
+            return ex.Exists(sub)
         if t.is_kw("case"):
             return self.parse_case()
         if t.is_kw("cast"):
@@ -484,6 +507,10 @@ class Parser:
                 raise SqlError(f"EXTRACT({part}) unsupported")
             return ex.ScalarFunction(f"extract_{part}", [inner])
         if self.accept_op("("):
+            if self.peek().is_kw("select"):
+                sub = self.parse_query()
+                self.expect_op(")")
+                return ex.ScalarSubquery(None, sub)
             e = self.parse_expr()
             self.expect_op(")")
             return e

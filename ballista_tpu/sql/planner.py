@@ -4,20 +4,27 @@ Replaces the DataFusion SQL planner the reference leans on (reference:
 rust/client/src/context.rs:131-144; scheduler-side planning at
 rust/scheduler/src/lib.rs:224-407). Key responsibilities:
 
-- name resolution against a catalog of registered tables, with table
-  aliases and qualified column refs;
+- name resolution against a catalog of registered tables AND derived
+  tables (FROM-subqueries), with table aliases; self-joins disambiguate by
+  renaming the duplicated relations' columns to ``alias__column`` and
+  resolving qualified refs through a per-alias rename map;
 - join graph extraction: explicit JOIN ... ON plus TPC-H-style comma FROM +
   WHERE equality conjuncts become a greedy join chain whose build sides are
   chosen by primary-key heuristics (build side must be the unique-key side
   for the FK fast path — see physical/join.py);
+- subqueries: [NOT] IN (SELECT ...) and [NOT] EXISTS (SELECT ...) are
+  decorrelated into semi/anti joins (equality correlation); scalar
+  subqueries are planned and inlined as literals at execution time
+  (execution.resolve_subqueries);
 - aggregate extraction: SELECT/HAVING/ORDER BY expressions over aggregates
   are rewritten to reference generated aggregate output columns;
+  COUNT(DISTINCT x) rewrites to a two-level aggregate;
 - DISTINCT -> group-by-all; ordinal GROUP BY/ORDER BY references.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dc_field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..datatypes import Schema
@@ -44,6 +51,17 @@ class CatalogTable:
     primary_key: Optional[str] = None  # unique column, for join-side choice
 
 
+@dataclass
+class Relation:
+    """One FROM item after resolution (base table or derived subquery)."""
+
+    alias: str
+    plan: LogicalPlan  # scan / derived plan, post-rename
+    schema: Schema  # exposed schema (post-rename)
+    primary_key: Optional[str]  # exposed pk name or None
+    rename: Dict[str, str]  # original column -> exposed name
+
+
 class SqlPlanner:
     def __init__(self, catalog: Dict[str, CatalogTable]):
         self.catalog = catalog
@@ -54,102 +72,364 @@ class SqlPlanner:
         if q.from_table is None:
             raise SqlError("SELECT without FROM not supported yet")
 
-        tables = self._resolve_tables(q)
-        where_conjuncts = (
-            self._qualify_conjuncts(q.where, tables) if q.where is not None else []
+        relations = self._resolve_relations(q)
+        col_owner = self._column_owners(relations)
+
+        conjuncts: List[ex.Expr] = []
+        if q.where is not None:
+            from ..optimizer import factor_or, split_conjuncts
+
+            for c in split_conjuncts(q.where):
+                # expose join conditions hidden inside OR-of-ANDs (q19)
+                for f in factor_or(c):
+                    conjuncts.append(self._qualify(f, relations, col_owner))
+
+        # pull subquery predicates out of the WHERE conjuncts
+        semi_specs, conjuncts = self._extract_subquery_predicates(
+            conjuncts, relations, col_owner
         )
-        plan, remaining = self._plan_joins(q, tables, where_conjuncts)
+
+        plan, remaining = self._plan_joins(
+            q, relations, col_owner, conjuncts, semi_specs
+        )
         if remaining:
             from ..optimizer import conjoin
 
             plan = Filter(conjoin(remaining), plan)
 
-        plan = self._plan_select(q, plan)
+        plan = self._plan_select(q, plan, relations, col_owner)
         return plan
 
-    # -------------------------------------------------------- FROM resolution
+    # ------------------------------------------------------- FROM resolution
 
-    def _resolve_tables(self, q: Query) -> List[Tuple[str, CatalogTable]]:
-        """[(alias, table)] in FROM order."""
-        out = []
+    def _resolve_relations(self, q: Query) -> List[Relation]:
         refs = [q.from_table] + [j.table for j in q.joins]
+        # duplicate-table detection: column names colliding across relations
+        raw: List[Tuple[str, TableRef, Schema, Optional[str], Optional[LogicalPlan]]] = []
         for r in refs:
-            if r.name not in self.catalog:
-                raise SqlError(f"unknown table {r.name!r}")
-            out.append((r.alias or r.name, self.catalog[r.name]))
+            alias = r.alias or r.name
+            if r.subquery is not None:
+                sub_plan = self.plan(r.subquery)
+                raw.append((alias, r, sub_plan.schema(), None, sub_plan))
+            else:
+                if r.name not in self.catalog:
+                    raise SqlError(f"unknown table {r.name!r}")
+                t = self.catalog[r.name]
+                raw.append(
+                    (alias, r, t.source.table_schema(), t.primary_key, None)
+                )
+        seen: Dict[str, int] = {}
+        for _, _, sch, _, _ in raw:
+            for n in sch.names():
+                seen[n] = seen.get(n, 0) + 1
+        dup_cols = {n for n, c in seen.items() if c > 1}
+
+        relations: List[Relation] = []
+        for alias, r, sch, pk, sub_plan in raw:
+            needs_rename = any(n in dup_cols for n in sch.names())
+            if sub_plan is not None:
+                base: LogicalPlan = sub_plan
+            else:
+                t = self.catalog[r.name]
+                base = TableScan(t.name, t.source)
+            if needs_rename:
+                rename = {
+                    n: (f"{alias}__{n}" if n in dup_cols else n)
+                    for n in sch.names()
+                }
+                base = Projection(
+                    [ex.ColumnRef(n).alias(rename[n]) for n in sch.names()],
+                    base,
+                )
+                new_schema = base.schema()
+                new_pk = rename.get(pk) if pk else None
+            else:
+                rename = {n: n for n in sch.names()}
+                new_schema = sch
+                new_pk = pk
+            relations.append(Relation(alias, base, new_schema, new_pk, rename))
+        return relations
+
+    def _column_owners(self, relations: List[Relation]) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        counts: Dict[str, int] = {}
+        for rel in relations:
+            for n in rel.schema.names():
+                out.setdefault(n, rel.alias)
+                counts[n] = counts.get(n, 0) + 1
+        # exposed names are unique post-rename; a residual dup is an error
+        for n, c in counts.items():
+            if c > 1:
+                raise SqlError(f"ambiguous column {n!r} after aliasing")
         return out
 
-    def _owner_of(self, colname: str, tables) -> Optional[str]:
-        """alias of the table owning an unqualified column name."""
-        owner = None
-        for alias, t in tables:
-            if t.source.table_schema().has_field(colname):
-                if owner is not None:
-                    raise SqlError(f"ambiguous column {colname!r}")
-                owner = alias
-        return owner
+    # ------------------------------------------------------- qualification
 
-    def _qualify(self, e: ex.Expr, tables) -> ex.Expr:
-        """Resolve qualified refs (alias.col -> col) after checking owners."""
+    def _qualify(self, e: ex.Expr, relations: List[Relation],
+                 col_owner: Dict[str, str], lenient: bool = False) -> ex.Expr:
+        by_alias = {r.alias: r for r in relations}
         if isinstance(e, ex.ColumnRef):
             if e.relation is not None:
-                aliases = {a for a, _ in tables}
-                if e.relation not in aliases:
+                rel = by_alias.get(e.relation)
+                if rel is None:
                     raise SqlError(f"unknown table alias {e.relation!r}")
-                return ex.ColumnRef(e.column)
-            if self._owner_of(e.column, tables) is None:
-                raise SqlError(f"unknown column {e.column!r}")
-            return e
+                if e.column not in rel.rename:
+                    raise SqlError(
+                        f"column {e.column!r} not in {e.relation!r}"
+                    )
+                return ex.ColumnRef(rel.rename[e.column])
+            if e.column in col_owner:
+                return e
+            # maybe the bare name was renamed by a self-join: unique match?
+            hits = [
+                r.rename[e.column] for r in relations if e.column in r.rename
+            ]
+            if len(hits) == 1:
+                return ex.ColumnRef(hits[0])
+            if len(hits) > 1:
+                raise SqlError(f"ambiguous column {e.column!r}")
+            if lenient:
+                # may be a SELECT alias / ordinal; resolved later against
+                # the output schema
+                return e
+            raise SqlError(f"unknown column {e.column!r}")
+        if isinstance(e, (ex.ScalarSubquery, ex.Exists, ex.InSubquery)):
+            return self._qualify_subquery_expr(e, relations, col_owner)
         for attr in ("expr", "left", "right", "base", "otherwise"):
             if hasattr(e, attr) and isinstance(getattr(e, attr), ex.Expr):
-                setattr(e, attr, self._qualify(getattr(e, attr), tables))
+                setattr(e, attr, self._qualify(getattr(e, attr), relations,
+                                               col_owner, lenient))
         if hasattr(e, "args"):
-            e.args = [self._qualify(a, tables) for a in e.args]
+            e.args = [self._qualify(a, relations, col_owner, lenient)
+                      for a in e.args]
         if hasattr(e, "list"):
-            e.list = [self._qualify(a, tables) for a in e.list]
+            e.list = [self._qualify(a, relations, col_owner, lenient)
+                      for a in e.list]
         if hasattr(e, "branches"):
             e.branches = [
-                (self._qualify(w, tables), self._qualify(t, tables))
+                (self._qualify(w, relations, col_owner, lenient),
+                 self._qualify(t, relations, col_owner, lenient))
                 for w, t in e.branches
             ]
         return e
 
-    def _qualify_conjuncts(self, where: ex.Expr, tables) -> List[ex.Expr]:
-        from ..optimizer import split_conjuncts
+    def _qualify_subquery_expr(self, e, relations, col_owner):
+        if isinstance(e, ex.InSubquery):
+            e.expr = self._qualify(e.expr, relations, col_owner)
+        if isinstance(e, ex.ScalarSubquery) and e.plan is None:
+            try:
+                e.plan = self.plan(e.query)  # uncorrelated
+            except SqlError:
+                # correlated: left for decorrelation at the WHERE level
+                e.plan = None
+        return e
 
-        return [self._qualify(c, tables) for c in split_conjuncts(where)]
+    # --------------------------------------------- subquery predicate lowering
+
+    def _extract_subquery_predicates(self, conjuncts, relations, col_owner):
+        """IN/EXISTS conjuncts -> semi/anti join specs.
+
+        Returns (specs, remaining_conjuncts). A spec is
+        (sub_plan, outer_col, sub_col, how).
+        """
+        specs = []  # (sub_plan, on_pairs [(outer_col, sub_col)], how)
+        remaining = []
+        self._corr_counter = getattr(self, "_corr_counter", 0)
+        for c in conjuncts:
+            neg = False
+            node = c
+            if isinstance(node, ex.Not) and isinstance(node.expr,
+                                                       (ex.Exists, ex.InSubquery)):
+                neg = True
+                node = node.expr
+            if isinstance(node, ex.InSubquery):
+                negated = neg or node.negated
+                inner = ex.strip_alias(node.expr)
+                if not isinstance(inner, ex.ColumnRef):
+                    raise SqlError("IN-subquery requires a column on the left")
+                sub_plan = self.plan(node.query)
+                sub_cols = sub_plan.schema().names()
+                if len(sub_cols) != 1:
+                    raise SqlError("IN-subquery must produce one column")
+                specs.append(
+                    (sub_plan, [(inner.column, sub_cols[0])],
+                     "anti" if negated else "semi", negated)
+                )
+                continue
+            if isinstance(node, ex.Exists):
+                negated = neg or node.negated
+                plan_, oc, ic, how = self._decorrelate_exists(
+                    node.query, relations, col_owner, negated
+                )
+                specs.append((plan_, [(oc, ic)], how, False))
+                continue
+            # correlated scalar subquery comparison: expr OP (SELECT agg ...)
+            handled = self._try_correlated_scalar(
+                node, relations, col_owner, specs, remaining
+            )
+            if handled:
+                continue
+            remaining.append(c)
+        return specs, remaining
+
+    def _try_correlated_scalar(self, node, relations, col_owner, specs,
+                               remaining) -> bool:
+        """lhs OP (correlated scalar subquery) -> derived group-by aggregate
+        joined on the correlation keys + plain comparison (classic
+        decorrelation; covers TPC-H q2/q17/q20)."""
+        if not (isinstance(node, ex.BinaryExpr) and node.op in ex.CMP_OPS):
+            return False
+        lhs, rhs = node.left, node.right
+        flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=",
+                "!=": "!="}
+        op = node.op
+        if isinstance(lhs, ex.ScalarSubquery) and lhs.plan is None:
+            lhs, rhs, op = rhs, lhs, flip[op]
+        if not (isinstance(rhs, ex.ScalarSubquery) and rhs.plan is None):
+            return False
+        sub_q: Query = rhs.query
+        if len(sub_q.items) != 1 or sub_q.items[0].star:
+            raise SqlError("correlated scalar subquery must select one expr")
+
+        from ..optimizer import conjoin, split_conjuncts
+
+        inner_rels = self._resolve_relations(sub_q)
+        inner_owner = self._column_owners(inner_rels)
+        corr_edges: List[Tuple[str, str]] = []
+        residual: List[ex.Expr] = []
+        if sub_q.where is not None:
+            for c in split_conjuncts(sub_q.where):
+                edge = self._correlation_edge(c, inner_rels, inner_owner,
+                                              relations, col_owner)
+                if edge is not None:
+                    corr_edges.append(edge)
+                else:
+                    residual.append(c)
+        if not corr_edges:
+            raise SqlError(
+                "correlated scalar subquery without equality correlation"
+            )
+        if len(corr_edges) > 2:
+            raise SqlError(">2 correlation columns (round 2)")
+
+        self._corr_counter += 1
+        n = self._corr_counter
+        key_aliases = [f"__corr_key{n}_{i}" for i in range(len(corr_edges))]
+        val_alias = f"__corr_val{n}"
+        derived_q = Query(
+            items=[
+                SelectItem(ex.ColumnRef(ic), ka)
+                for (_, ic), ka in zip(corr_edges, key_aliases)
+            ] + [SelectItem(sub_q.items[0].expr, val_alias)],
+            from_table=sub_q.from_table,
+            joins=sub_q.joins,
+            where=conjoin(residual) if residual else None,
+            group_by=[ex.ColumnRef(ic) for _, ic in corr_edges],
+            having=None, order_by=[], limit=None,
+        )
+        derived = self.plan(derived_q)
+        on_pairs = [
+            (oc, ka) for (oc, _), ka in zip(corr_edges, key_aliases)
+        ]
+        specs.append((derived, on_pairs, "inner", False))
+        remaining.append(
+            ex.BinaryExpr(lhs, op, ex.ColumnRef(val_alias))
+        )
+        return True
+
+    def _decorrelate_exists(self, sub_q: Query, outer_relations, outer_owner,
+                            negated: bool):
+        """EXISTS with equality correlation -> semi/anti join spec."""
+        from ..optimizer import conjoin, split_conjuncts
+
+        inner_rels = self._resolve_relations(sub_q)
+        inner_owner = self._column_owners(inner_rels)
+        corr_edges: List[Tuple[str, str]] = []  # (outer_col, inner_col)
+        inner_conjs: List[ex.Expr] = []
+        if sub_q.where is not None:
+            for c in split_conjuncts(sub_q.where):
+                edge = self._correlation_edge(c, inner_rels, inner_owner,
+                                              outer_relations, outer_owner)
+                if edge is not None:
+                    corr_edges.append(edge)
+                else:
+                    inner_conjs.append(
+                        self._qualify(c, inner_rels, inner_owner)
+                    )
+        if not corr_edges:
+            raise SqlError(
+                "EXISTS subquery without equality correlation unsupported"
+            )
+        if len(corr_edges) > 1:
+            raise SqlError("multi-column EXISTS correlation (round 2)")
+        # plan the inner query body: join chain + residual filters
+        inner_q = Query(
+            items=[SelectItem(ex.ColumnRef(corr_edges[0][1]), None)],
+            from_table=sub_q.from_table, joins=sub_q.joins, where=None,
+            group_by=[], having=None, order_by=[], limit=None,
+        )
+        plan, remaining = self._plan_joins(
+            inner_q, inner_rels, inner_owner, inner_conjs, []
+        )
+        if remaining:
+            plan = Filter(conjoin(remaining), plan)
+        outer_col, inner_col = corr_edges[0]
+        plan = Projection([ex.ColumnRef(inner_col)], plan)
+        return (plan, outer_col, inner_col, "anti" if negated else "semi")
+
+    def _correlation_edge(self, c, inner_rels, inner_owner, outer_rels,
+                          outer_owner):
+        """outer_col = inner_col equality conjunct, else None."""
+        if not (isinstance(c, ex.BinaryExpr) and c.op == "="):
+            return None
+        sides = [c.left, c.right]
+        if not all(isinstance(s, ex.ColumnRef) for s in sides):
+            return None
+
+        def resolve(ref, rels, owner):
+            try:
+                q = self._qualify(
+                    ex.ColumnRef(ref.column, ref.relation), rels, owner
+                )
+                return q.column
+            except SqlError:
+                return None
+
+        for a, b in ((0, 1), (1, 0)):
+            # SQL scoping: a column resolvable in the INNER scope binds
+            # there; the correlated side is the one that only resolves in
+            # the outer scope
+            inner_c = resolve(sides[a], inner_rels, inner_owner)
+            inner_of_b = resolve(sides[b], inner_rels, inner_owner)
+            outer_c = resolve(sides[b], outer_rels, outer_owner)
+            if inner_c and outer_c and inner_of_b is None:
+                return (outer_c, inner_c)
+        return None
 
     # ------------------------------------------------------------ join graph
 
-    def _plan_joins(self, q: Query, tables, conjuncts):
-        """Greedy join chain; returns (plan, leftover conjuncts).
+    def _plan_joins(self, q: Query, relations: List[Relation],
+                    col_owner: Dict[str, str], conjuncts, semi_specs):
+        """Greedy join chain; returns (plan, leftover conjuncts)."""
 
-        Build-side choice: when adding table T to the accumulated plan via
-        edge (acc_col = t_col), use Join(left=T, right=acc) iff t_col is T's
-        primary key (fast FK probe into acc), else Join(left=acc, right=T)
-        iff acc_col is unique in acc; else default to build=T (runtime
-        expanding join handles duplicates).
-        """
-        alias_schema = {a: t.source.table_schema() for a, t in tables}
-        col_owner: Dict[str, str] = {}
-        for a, t in tables:
-            for n in t.source.table_schema().names():
-                # later duplicates are ambiguous; _owner_of catches misuse
-                col_owner.setdefault(n, a)
-
-        # single-table fast path
-        if len(tables) == 1:
-            alias, t = tables[0]
-            return TableScan(t.name, t.source), conjuncts
-
-        # classify conjuncts
         def owners(e: ex.Expr) -> Set[str]:
-            return {col_owner[c] for c in ex.referenced_columns(e) if c in col_owner}
+            return {col_owner[c] for c in ex.referenced_columns(e)
+                    if c in col_owner}
 
-        join_edges: List[Tuple[str, str, str, str]] = []  # (a1, c1, a2, c2)
-        table_filters: Dict[str, List[ex.Expr]] = {a: [] for a, _ in tables}
+        join_edges: List[Tuple[str, str, str, str]] = []
+        table_filters: Dict[str, List[ex.Expr]] = {r.alias: [] for r in relations}
         post: List[ex.Expr] = []
-        for c in conjuncts:
+        # WHERE predicates must run post-join for any null-extended side:
+        # the right table of a LEFT JOIN, or everything else under a RIGHT
+        # JOIN (conservative)
+        explicit_joins = {
+            (j.table.alias or j.table.name): j.how for j in q.joins
+            if j.how != "cross"
+        }
+        no_push = {a for a, h in explicit_joins.items() if h == "left"}
+        any_right = any(h == "right" for h in explicit_joins.values())
+
+        def classify(c: ex.Expr, from_where: bool = True):
             if (
                 isinstance(c, ex.BinaryExpr) and c.op == "="
                 and isinstance(c.left, ex.ColumnRef)
@@ -159,93 +439,141 @@ class SqlPlanner:
                 o2 = col_owner.get(c.right.column)
                 if o1 and o2 and o1 != o2:
                     join_edges.append((o1, c.left.column, o2, c.right.column))
-                    continue
-            os = owners(c)
-            if len(os) == 1:
-                table_filters[next(iter(os))].append(c)
+                    return
+            refs = ex.referenced_columns(c)
+            if any(r not in col_owner for r in refs):
+                # references a subquery-derived column (__corr_val...):
+                # must run after those joins are applied
+                post.append(c)
+                return
+            os_ = owners(c)
+            if len(os_) == 1:
+                owner = next(iter(os_))
+                if from_where and (owner in no_push or any_right):
+                    post.append(c)
+                else:
+                    table_filters[owner].append(c)
             else:
                 post.append(c)
 
-        # explicit JOIN ... ON clauses contribute edges / filters too
+        for c in conjuncts:
+            classify(c, from_where=True)
+
         explicit_how: Dict[str, str] = {}
         for j in q.joins:
             alias = j.table.alias or j.table.name
             if j.how != "cross":
                 explicit_how[alias] = j.how
             if j.on is not None:
-                for c in self._qualify_conjuncts(j.on, tables):
-                    if (
-                        isinstance(c, ex.BinaryExpr) and c.op == "="
-                        and isinstance(c.left, ex.ColumnRef)
-                        and isinstance(c.right, ex.ColumnRef)
-                    ):
-                        o1 = col_owner.get(c.left.column)
-                        o2 = col_owner.get(c.right.column)
-                        if o1 and o2 and o1 != o2:
-                            join_edges.append((o1, c.left.column, o2, c.right.column))
-                            continue
-                    post.append(c)
+                from ..optimizer import split_conjuncts
 
-        def scan_with_filters(alias: str) -> LogicalPlan:
-            t = dict(tables)[alias]
-            p: LogicalPlan = TableScan(t.name, t.source)
+                for c in split_conjuncts(j.on):
+                    # ON-clause filters DO apply pre-join on the new table
+                    classify(self._qualify(c, relations, col_owner),
+                             from_where=False)
+
+        def filtered_plan(rel: Relation) -> LogicalPlan:
             from ..optimizer import conjoin
 
-            if table_filters[alias]:
-                p = Filter(conjoin(table_filters[alias]), p)
+            p = rel.plan
+            if table_filters[rel.alias]:
+                p = Filter(conjoin(table_filters[rel.alias]), p)
             return p
 
-        # greedy chain in FROM order
-        joined: Set[str] = {tables[0][0]}
-        plan = scan_with_filters(tables[0][0])
-        # unique cols currently valid for the accumulated plan's rows
+        if len(relations) == 1:
+            plan: LogicalPlan = relations[0].plan
+            leftover = table_filters[relations[0].alias] + post
+        else:
+            plan, leftover = self._join_chain(
+                relations, join_edges, explicit_how, filtered_plan, post
+            )
+
+        # apply subquery-derived joins (semi/anti/correlated-scalar) on top
+        for sub_plan, on_pairs, how, null_aware in semi_specs:
+            if how == "inner":
+                # derived aggregates have unique group keys: put them on
+                # the build (left) side for the FK fast path
+                plan = Join(sub_plan, plan,
+                            [(s_, o) for o, s_ in on_pairs], how)
+            else:
+                plan = Join(plan, sub_plan, list(on_pairs), how,
+                            null_aware=null_aware)
+        return plan, leftover
+
+    def _join_chain(self, relations, join_edges, explicit_how, filtered_plan,
+                    post):
+        by_alias = {r.alias: r for r in relations}
+        joined: Set[str] = {relations[0].alias}
+        plan = filtered_plan(relations[0])
         acc_unique: Set[str] = set()
-        pk0 = dict(tables)[tables[0][0]].primary_key
-        if pk0:
-            acc_unique.add(pk0)
-        pending = [a for a, _ in tables[1:]]
+        if relations[0].primary_key:
+            acc_unique.add(relations[0].primary_key)
+        pending = [r.alias for r in relations[1:]]
         edges = list(join_edges)
 
         while pending:
             progress = False
             for alias in list(pending):
-                # find an edge connecting alias to the joined set
-                edge = None
-                used = None
+                # collect ALL edges connecting alias to the joined set: up
+                # to two become composite join keys (e.g. partsupp's
+                # (partkey, suppkey)); extras fall back to post filters
+                mine: List[Tuple[Tuple[str, str], tuple]] = []
                 for e_ in edges:
                     a1, c1, a2, c2 = e_
                     if a1 == alias and a2 in joined:
-                        edge, used = (alias, c1, a2, c2), e_
-                        break
-                    if a2 == alias and a1 in joined:
-                        edge, used = (alias, c2, a1, c1), e_
-                        break
-                if edge is None:
+                        mine.append(((c1, c2), e_))
+                    elif a2 == alias and a1 in joined:
+                        mine.append(((c2, c1), e_))
+                if not mine:
                     continue
-                t_alias, t_col, _, acc_col = edge
-                t = dict(tables)[t_alias]
-                t_plan = scan_with_filters(t_alias)
+                key_pairs = [p for p, _ in mine[:2]]  # (t_col, acc_col)
+                extra = mine[2:]
+                t_alias = alias
+                rel = by_alias[t_alias]
+                t_plan = filtered_plan(rel)
                 how = explicit_how.get(t_alias, "inner")
-                if t.primary_key == t_col:
-                    # build the new (dimension) table, probe the acc
+                t_col = key_pairs[0][0]
+                acc_col = key_pairs[0][1]
+                if len(key_pairs) == 2 and how == "inner":
+                    # composite join: build the new table (runtime
+                    # uniqueness detection picks the fast path when the
+                    # composite key is unique, e.g. partsupp)
+                    on = [(t, a) for t, a in key_pairs]
+                    plan = Join(t_plan, plan, on, how)
+                elif len(key_pairs) == 2:
+                    # outer joins preserve the accumulated side
+                    on = [(a, t) for t, a in key_pairs]
+                    plan = Join(plan, t_plan, on, how)
+                    acc_unique = set()
+                elif rel.primary_key == t_col and how == "inner":
                     plan = Join(t_plan, plan, [(t_col, acc_col)], how)
-                    # acc row granularity unchanged -> acc_unique survives
-                elif acc_col in acc_unique:
+                elif acc_col in acc_unique and how == "inner":
                     plan = Join(plan, t_plan, [(acc_col, t_col)], how)
-                    acc_unique = {t.primary_key} if t.primary_key else set()
+                    acc_unique = (
+                        {rel.primary_key} if rel.primary_key else set()
+                    )
+                elif how in ("left", "right"):
+                    # outer joins: the accumulated side is the logical left
+                    plan = Join(plan, t_plan, [(acc_col, t_col)], how)
+                    acc_unique = set()
                 else:
                     plan = Join(t_plan, plan, [(t_col, acc_col)], how)
                 joined.add(t_alias)
                 pending.remove(t_alias)
-                edges.remove(used)
-                # leftover edges between already-joined tables become
-                # post-join equality filters (e.g. q5's c_nationkey =
-                # s_nationkey once both sides are in the chain)
+                for _, e_ in mine[:2]:
+                    edges.remove(e_)
+                for (c1, c2), e_ in extra:
+                    post.append(
+                        ex.BinaryExpr(ex.ColumnRef(c1), "=", ex.ColumnRef(c2))
+                    )
+                    edges.remove(e_)
                 resolved = [
                     e_ for e_ in edges if e_[0] in joined and e_[2] in joined
                 ]
                 for a1, c1, a2, c2 in resolved:
-                    post.append(ex.BinaryExpr(ex.col(c1), "=", ex.col(c2)))
+                    post.append(
+                        ex.BinaryExpr(ex.ColumnRef(c1), "=", ex.ColumnRef(c2))
+                    )
                 edges = [e_ for e_ in edges if e_ not in resolved]
                 progress = True
             if not progress:
@@ -256,37 +584,52 @@ class SqlPlanner:
 
     # -------------------------------------------------- SELECT/agg/order/limit
 
-    def _plan_select(self, q: Query, plan: LogicalPlan) -> LogicalPlan:
+    def _plan_select(self, q: Query, plan: LogicalPlan,
+                     relations, col_owner) -> LogicalPlan:
         in_schema = plan.schema()
 
-        # expand stars
         items: List[SelectItem] = []
         for it in q.items:
             if it.star:
                 for n in in_schema.names():
                     items.append(SelectItem(ex.ColumnRef(n), None))
             else:
-                items.append(it)
+                e = self._qualify(it.expr, relations, col_owner)
+                items.append(SelectItem(e, it.alias))
 
         select_exprs = [
             it.expr.alias(it.alias) if it.alias else it.expr for it in items
         ]
 
-        # resolve GROUP BY entries (ordinals / aliases / exprs)
         group_exprs: List[ex.Expr] = []
         for g in q.group_by:
-            group_exprs.append(self._resolve_ref(g, items, in_schema))
+            g = self._resolve_ref(
+                self._qualify(g, relations, col_owner, lenient=True),
+                items, in_schema,
+            )
+            group_exprs.append(g)
+
+        having = (
+            self._qualify(q.having, relations, col_owner, lenient=True)
+            if q.having is not None else None
+        )
+        order_items = [
+            OrderItem(self._qualify(oi.expr, relations, col_owner,
+                                    lenient=True),
+                      oi.ascending, oi.nulls_first)
+            for oi in q.order_by
+        ]
 
         has_aggs = any(self._contains_agg(e) for e in select_exprs) or (
-            q.having is not None and self._contains_agg(q.having)
+            having is not None and self._contains_agg(having)
         )
         distinct = q.distinct
 
         if group_exprs or has_aggs:
-            plan = self._plan_aggregate(q, plan, select_exprs, group_exprs)
+            plan = self._plan_aggregate(q, plan, select_exprs, group_exprs,
+                                        having, order_items)
         else:
             if distinct:
-                # DISTINCT == group by all output columns
                 proj = Projection(select_exprs, plan)
                 names = proj.schema().names()
                 plan = Aggregate([ex.ColumnRef(n) for n in names], [], proj)
@@ -296,10 +639,9 @@ class SqlPlanner:
 
         out_schema = plan.schema()
 
-        # ORDER BY (may reference output aliases, ordinals, or input cols)
-        if q.order_by:
+        if order_items:
             sort_exprs = []
-            for oi in q.order_by:
+            for oi in order_items:
                 e = self._resolve_order_ref(oi.expr, items, out_schema)
                 sort_exprs.append(ex.SortExpr(e, oi.ascending,
                                               bool(oi.nulls_first)))
@@ -309,8 +651,8 @@ class SqlPlanner:
             plan = Limit(q.limit, plan)
         return plan
 
-    def _plan_aggregate(self, q: Query, plan, select_exprs, group_exprs):
-        # collect aggregate subexpressions across SELECT + HAVING + ORDER BY
+    def _plan_aggregate(self, q: Query, plan, select_exprs, group_exprs,
+                        having, order_items):
         aggs: List[ex.AggregateExpr] = []
 
         def collect(e: ex.Expr):
@@ -321,18 +663,35 @@ class SqlPlanner:
 
         for e in select_exprs:
             collect(e)
-        if q.having is not None:
-            collect(q.having)
-        for oi in q.order_by:
+        if having is not None:
+            collect(having)
+        for oi in order_items:
             collect(oi.expr)
 
-        agg_plan = Aggregate(group_exprs, list(aggs), plan)
+        # COUNT(DISTINCT x) -> two-level aggregate rewrite
+        distinct_aggs = [a for a in aggs if a.fn == "count_distinct"]
+        if distinct_aggs:
+            if len(distinct_aggs) != len(aggs):
+                raise SqlError(
+                    "mixing COUNT(DISTINCT) with other aggregates (round 2)"
+                )
+            if len(distinct_aggs) > 1:
+                raise SqlError("multiple COUNT(DISTINCT) aggregates (round 2)")
+            da = distinct_aggs[0]
+            inner = Aggregate(group_exprs + [da.expr], [], plan)
+            inner_names = inner.schema().names()
+            outer_groups = [ex.ColumnRef(n) for n in inner_names[:-1]]
+            counted = ex.AggregateExpr(
+                "count", ex.ColumnRef(inner_names[-1])
+            ).alias(da.name())
+            agg_plan = Aggregate(outer_groups, [counted], inner)
+        else:
+            agg_plan = Aggregate(group_exprs, list(aggs), plan)
         agg_schema = agg_plan.schema()
 
         group_names = {g.name() for g in group_exprs}
 
         def rewrite(e: ex.Expr) -> ex.Expr:
-            """Replace aggregate subtrees / group exprs with output col refs."""
             if isinstance(e, ex.Alias):
                 return ex.Alias(rewrite(e.expr), e.alias_name)
             if isinstance(e, ex.AggregateExpr):
@@ -351,10 +710,9 @@ class SqlPlanner:
             return e
 
         out: LogicalPlan = agg_plan
-        if q.having is not None:
-            out = Filter(rewrite(self._resolve_ref(q.having, [], agg_schema)), out)
+        if having is not None:
+            out = Filter(rewrite(having), out)
         projected = [rewrite(e) for e in select_exprs]
-        # validate non-aggregate select exprs reference group cols only
         for e in projected:
             for node in ex.walk(e):
                 if isinstance(node, ex.ColumnRef) and not agg_schema.has_field(
@@ -365,17 +723,14 @@ class SqlPlanner:
                     )
         return Projection(projected, out)
 
-    # ------------------------------------------------------------- reference
-    # resolution helpers
+    # ---------------------------------------------------- reference helpers
 
     def _resolve_ref(self, e: ex.Expr, items: List[SelectItem], schema: Schema):
-        # ordinal (1-based)
         if isinstance(e, ex.Literal) and e.dtype.is_integer and items:
             idx = int(e.value) - 1
             if 0 <= idx < len(items):
                 return items[idx].expr
             raise SqlError(f"ordinal {e.value} out of range")
-        # output alias
         if isinstance(e, ex.ColumnRef) and not schema.has_field(e.column):
             for it in items:
                 if it.alias == e.column:

@@ -1,0 +1,51 @@
+"""Plan visualization + misc utilities.
+
+(reference: rust/core/src/utils.rs:96-290 — format_plan pretty-printers and
+``produce_diagram``, a GraphViz dot rendering of the stage DAG.)
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .physical.base import PhysicalPlan
+from .physical.shuffle import QueryStageExec, UnresolvedShuffleExec
+
+
+def produce_diagram(stages: List[QueryStageExec]) -> str:
+    """GraphViz dot of a job's stage DAG: one cluster per stage, edges from
+    producing stages into the UnresolvedShuffle readers that consume them."""
+    out = ["digraph G {", '  rankdir="BT";']
+    node_ids = {}
+    counter = [0]
+
+    def emit(plan: PhysicalPlan, stage_idx: int) -> str:
+        nid = f"s{stage_idx}_n{counter[0]}"
+        counter[0] += 1
+        label = plan.display().replace('"', "'")
+        out.append(f'    {nid} [shape=box, label="{label}"];')
+        for child in plan.children():
+            cid = emit(child, stage_idx)
+            out.append(f"    {cid} -> {nid};")
+        if isinstance(plan, UnresolvedShuffleExec):
+            for sid in plan.query_stage_ids:
+                node_ids.setdefault(("shuffle_in", sid), []).append(nid)
+        return nid
+
+    for stage in stages:
+        out.append(f"  subgraph cluster_{stage.stage_id} {{")
+        out.append(f'    label = "Stage {stage.stage_id}";')
+        root = emit(stage.child, stage.stage_id)
+        node_ids[("stage_root", stage.stage_id)] = root
+        out.append("  }")
+
+    # cross-stage edges: producing stage root -> consuming shuffle node
+    for (kind, sid), nids in list(node_ids.items()):
+        if kind != "shuffle_in":
+            continue
+        root = node_ids.get(("stage_root", sid))
+        if root:
+            for nid in nids:
+                out.append(f"  {root} -> {nid} [style=dashed];")
+    out.append("}")
+    return "\n".join(out)

@@ -43,8 +43,19 @@ TYPE_S3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
 BRANDS = [f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)]
 NOUNS = ["packages", "requests", "accounts", "deposits", "foxes", "ideas",
          "theodolites", "pinto beans", "instructions", "dependencies"]
+COLORS = ["almond", "antique", "aquamarine", "azure", "beige", "bisque",
+          "black", "blanched", "blue", "blush", "brown", "burlywood",
+          "chartreuse", "green", "red", "white", "yellow", "ivory"]
 VERBS = ["sleep", "wake", "haggle", "nag", "cajole", "detect", "integrate",
          "boost", "doze", "wake blithely"]
+
+
+def _phones(rng, n):
+    # country prefix 10-34 like dbgen (q22 uses the 2-digit country code)
+    return np.char.add(
+        np.char.add(rng.integers(10, 35, n).astype(str), "-"),
+        rng.integers(10**6, 10**7, n).astype(str),
+    )
 
 
 def _comments(rng, n):
@@ -110,7 +121,7 @@ def generate(data_dir: str, scale: float = 0.01, num_parts: int = 2,
         np.char.add("Supplier#", skey.astype(str)),
         np.char.add("Addr S", rng.integers(0, 10**6, n_supp).astype(str)),
         rng.integers(0, 25, n_supp),
-        np.char.add("27-", rng.integers(10**6, 10**7, n_supp).astype(str)),
+        _phones(rng, n_supp),
         _money(rng, n_supp, -999.99, 9999.99),
         _comments(rng, n_supp),
     ], 1)
@@ -122,7 +133,7 @@ def generate(data_dir: str, scale: float = 0.01, num_parts: int = 2,
         np.char.add("Customer#", ckey.astype(str)),
         np.char.add("Addr C", rng.integers(0, 10**6, n_cust).astype(str)),
         rng.integers(0, 25, n_cust),
-        np.char.add("27-", rng.integers(10**6, 10**7, n_cust).astype(str)),
+        _phones(rng, n_cust),
         _money(rng, n_cust, -999.99, 9999.99),
         rng.choice(SEGMENTS, n_cust),
         _comments(rng, n_cust),
@@ -138,7 +149,10 @@ def generate(data_dir: str, scale: float = 0.01, num_parts: int = 2,
     retail = (90000 + (pkey % 20001) + 100 * (pkey % 1000)) / 100.0
     _write_tbl(os.path.join(data_dir, "part"), [
         pkey,
-        np.char.add("part name ", rng.choice(NOUNS, n_part)),
+        np.char.add(
+            np.char.add(rng.choice(COLORS, n_part), " "),
+            rng.choice(NOUNS, n_part),
+        ),
         np.char.add("Manufacturer#", rng.integers(1, 6, n_part).astype(str)),
         rng.choice(BRANDS, n_part),
         ptype,

@@ -160,4 +160,294 @@ def q12(t):
     return out
 
 
-ORACLES = {"q1": q1, "q3": q3, "q5": q5, "q6": q6, "q10": q10, "q12": q12}
+def q4(t):
+    o, l = t["orders"], t["lineitem"]
+    o = o[(o.o_orderdate >= _D("1993-07-01")) & (o.o_orderdate < _D("1993-10-01"))]
+    late = l[l.l_commitdate < l.l_receiptdate].l_orderkey.unique()
+    d = o[o.o_orderkey.isin(late)]
+    return (
+        d.groupby("o_orderpriority").size().reset_index(name="order_count")
+        .sort_values("o_orderpriority").reset_index(drop=True)
+    )
+
+
+def q7(t):
+    s, l, o, c, n = (t["supplier"], t["lineitem"], t["orders"], t["customer"],
+                     t["nation"])
+    l = l[(l.l_shipdate >= _D("1995-01-01")) & (l.l_shipdate <= _D("1996-12-31"))]
+    j = (
+        l.merge(s, left_on="l_suppkey", right_on="s_suppkey")
+        .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+        .merge(n.add_prefix("n1_"), left_on="s_nationkey",
+               right_on="n1_n_nationkey")
+        .merge(n.add_prefix("n2_"), left_on="c_nationkey",
+               right_on="n2_n_nationkey")
+    )
+    j = j[
+        ((j.n1_n_name == "FRANCE") & (j.n2_n_name == "GERMANY"))
+        | ((j.n1_n_name == "GERMANY") & (j.n2_n_name == "FRANCE"))
+    ]
+    j["volume"] = j.l_extendedprice * (1 - j.l_discount)
+    j["l_year"] = pd.to_datetime(j.l_shipdate).dt.year
+    out = (
+        j.groupby([j.n1_n_name.rename("supp_nation"),
+                   j.n2_n_name.rename("cust_nation"), "l_year"])["volume"]
+        .sum().reset_index().rename(columns={"volume": "revenue"})
+        .sort_values(["supp_nation", "cust_nation", "l_year"])
+        .reset_index(drop=True)
+    )
+    return out
+
+
+def q8(t):
+    p, s, l, o, c, n, r = (t["part"], t["supplier"], t["lineitem"],
+                           t["orders"], t["customer"], t["nation"], t["region"])
+    o = o[(o.o_orderdate >= _D("1995-01-01")) & (o.o_orderdate <= _D("1996-12-31"))]
+    p = p[p.p_type == "ECONOMY ANODIZED STEEL"]
+    j = (
+        l.merge(p, left_on="l_partkey", right_on="p_partkey")
+        .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+        .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+        .merge(n.add_prefix("n1_"), left_on="c_nationkey",
+               right_on="n1_n_nationkey")
+        .merge(r, left_on="n1_n_regionkey", right_on="r_regionkey")
+        .merge(n.add_prefix("n2_"), left_on="s_nationkey",
+               right_on="n2_n_nationkey")
+    )
+    j = j[j.r_name == "AMERICA"]
+    j["o_year"] = pd.to_datetime(j.o_orderdate).dt.year
+    j["volume"] = j.l_extendedprice * (1 - j.l_discount)
+    j["brazil"] = np.where(j.n2_n_name == "BRAZIL", j.volume, 0.0)
+    out = (
+        j.groupby("o_year").agg(b=("brazil", "sum"), v=("volume", "sum"))
+        .reset_index()
+    )
+    out["mkt_share"] = out.b / out.v
+    return out[["o_year", "mkt_share"]].sort_values("o_year").reset_index(drop=True)
+
+
+def q9(t):
+    p, s, l, ps, o, n = (t["part"], t["supplier"], t["lineitem"],
+                         t["partsupp"], t["orders"], t["nation"])
+    p = p[p.p_name.str.contains("green")]
+    j = (
+        l.merge(p, left_on="l_partkey", right_on="p_partkey")
+        .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+        .merge(ps, left_on=["l_partkey", "l_suppkey"],
+               right_on=["ps_partkey", "ps_suppkey"])
+        .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+    )
+    j["o_year"] = pd.to_datetime(j.o_orderdate).dt.year
+    j["amount"] = (j.l_extendedprice * (1 - j.l_discount)
+                   - j.ps_supplycost * j.l_quantity)
+    return (
+        j.groupby([j.n_name.rename("nation"), "o_year"])["amount"].sum()
+        .reset_index().rename(columns={"amount": "sum_profit"})
+        .sort_values(["nation", "o_year"], ascending=[True, False])
+        .reset_index(drop=True)
+    )
+
+
+def q11(t):
+    ps, s, n = t["partsupp"], t["supplier"], t["nation"]
+    j = ps.merge(s, left_on="ps_suppkey", right_on="s_suppkey").merge(
+        n, left_on="s_nationkey", right_on="n_nationkey"
+    )
+    j = j[j.n_name == "GERMANY"]
+    j["value"] = j.ps_supplycost * j.ps_availqty
+    total = j.value.sum() * 0.0001
+    out = j.groupby("ps_partkey")["value"].sum().reset_index()
+    out = out[out.value > total]
+    return out.sort_values("value", ascending=False).reset_index(drop=True)
+
+
+def q13(t):
+    c, o = t["customer"], t["orders"]
+    o = o[~o.o_comment.str.contains("special.*requests")]
+    counts = (
+        c.merge(o, left_on="c_custkey", right_on="o_custkey", how="left")
+        .groupby("c_custkey")["o_orderkey"].count().reset_index(name="c_count")
+    )
+    return (
+        counts.groupby("c_count").size().reset_index(name="custdist")
+        .sort_values(["custdist", "c_count"], ascending=[False, False])
+        .reset_index(drop=True)
+    )
+
+
+def q14(t):
+    l, p = t["lineitem"], t["part"]
+    l = l[(l.l_shipdate >= _D("1995-09-01")) & (l.l_shipdate < _D("1995-10-01"))]
+    j = l.merge(p, left_on="l_partkey", right_on="p_partkey")
+    rev = j.l_extendedprice * (1 - j.l_discount)
+    promo = rev.where(j.p_type.str.startswith("PROMO"), 0.0)
+    return pd.DataFrame({"promo_revenue": [100.0 * promo.sum() / rev.sum()]})
+
+
+def q16(t):
+    ps, p, s = t["partsupp"], t["part"], t["supplier"]
+    bad = s[s.s_comment.str.contains("Customer.*Complaints")].s_suppkey
+    d = p[
+        (p.p_brand != "Brand#45")
+        & ~p.p_type.str.startswith("MEDIUM POLISHED")
+        & p.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])
+    ]
+    j = ps.merge(d, left_on="ps_partkey", right_on="p_partkey")
+    j = j[~j.ps_suppkey.isin(bad)]
+    out = (
+        j.groupby(["p_brand", "p_type", "p_size"])["ps_suppkey"].nunique()
+        .reset_index(name="supplier_cnt")
+        .sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                     ascending=[False, True, True, True])
+        .reset_index(drop=True)
+    )
+    return out
+
+
+def q18(t):
+    c, o, l = t["customer"], t["orders"], t["lineitem"]
+    big = l.groupby("l_orderkey")["l_quantity"].sum()
+    big = big[big > 300].index
+    j = (
+        l[l.l_orderkey.isin(big)]
+        .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+    )
+    out = (
+        j.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                   "o_totalprice"])["l_quantity"].sum()
+        .reset_index(name="total_qty")
+        .sort_values(["o_totalprice", "o_orderdate"], ascending=[False, True])
+        .head(100).reset_index(drop=True)
+    )
+    return out
+
+
+def q19(t):
+    l, p = t["lineitem"], t["part"]
+    j = l.merge(p, left_on="l_partkey", right_on="p_partkey")
+    common = j.l_shipmode.isin(["AIR", "AIR REG"]) & (
+        j.l_shipinstruct == "DELIVER IN PERSON"
+    )
+    b1 = (
+        (j.p_brand == "Brand#12")
+        & j.p_container.isin(["SM CASE", "SM BOX", "SM PACK", "SM PKG"])
+        & (j.l_quantity >= 1) & (j.l_quantity <= 11)
+        & (j.p_size >= 1) & (j.p_size <= 5)
+    )
+    b2 = (
+        (j.p_brand == "Brand#23")
+        & j.p_container.isin(["MED BAG", "MED BOX", "MED PKG", "MED PACK"])
+        & (j.l_quantity >= 10) & (j.l_quantity <= 20)
+        & (j.p_size >= 1) & (j.p_size <= 10)
+    )
+    b3 = (
+        (j.p_brand == "Brand#34")
+        & j.p_container.isin(["LG CASE", "LG BOX", "LG PACK", "LG PKG"])
+        & (j.l_quantity >= 20) & (j.l_quantity <= 30)
+        & (j.p_size >= 1) & (j.p_size <= 15)
+    )
+    d = j[common & (b1 | b2 | b3)]
+    # SQL: SUM over zero rows is NULL (NaN), not 0
+    rev = (d.l_extendedprice * (1 - d.l_discount)).sum() if len(d) else np.nan
+    return pd.DataFrame({"revenue": [rev]})
+
+
+def q22(t):
+    c, o = t["customer"], t["orders"]
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    cc = c[c.c_phone.str[:2].isin(codes)]
+    avg_bal = cc[cc.c_acctbal > 0].c_acctbal.mean()
+    d = cc[(cc.c_acctbal > avg_bal) & ~cc.c_custkey.isin(o.o_custkey)]
+    out = (
+        d.assign(cntrycode=d.c_phone.str[:2])
+        .groupby("cntrycode")
+        .agg(numcust=("c_acctbal", "size"), totacctbal=("c_acctbal", "sum"))
+        .reset_index().sort_values("cntrycode").reset_index(drop=True)
+    )
+    return out
+
+
+def q2(t):
+    p, s, ps, n, r = (t["part"], t["supplier"], t["partsupp"], t["nation"],
+                      t["region"])
+    europe = (
+        ps.merge(s, left_on="ps_suppkey", right_on="s_suppkey")
+        .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+        .merge(r, left_on="n_regionkey", right_on="r_regionkey")
+    )
+    europe = europe[europe.r_name == "EUROPE"]
+    mins = europe.groupby("ps_partkey")["ps_supplycost"].min().reset_index(
+        name="min_cost"
+    )
+    d = p[(p.p_size == 15) & p.p_type.str.endswith("BRASS")]
+    j = (
+        europe.merge(d, left_on="ps_partkey", right_on="p_partkey")
+        .merge(mins, on="ps_partkey")
+    )
+    j = j[j.ps_supplycost == j.min_cost]
+    out = j[["s_acctbal", "s_name", "n_name", "p_partkey", "p_mfgr",
+             "s_address", "s_phone", "s_comment"]]
+    return (
+        out.sort_values(["s_acctbal", "n_name", "s_name", "p_partkey"],
+                        ascending=[False, True, True, True])
+        .head(100).reset_index(drop=True)
+    )
+
+
+def q15(t):
+    s, l = t["supplier"], t["lineitem"]
+    d = l[(l.l_shipdate >= _D("1996-01-01")) & (l.l_shipdate < _D("1996-04-01"))]
+    rev = (
+        d.assign(r=d.l_extendedprice * (1 - d.l_discount))
+        .groupby("l_suppkey")["r"].sum().reset_index(name="total_revenue")
+    )
+    top = rev[rev.total_revenue == rev.total_revenue.max()]
+    j = s.merge(top, left_on="s_suppkey", right_on="l_suppkey")
+    return (
+        j[["s_suppkey", "s_name", "s_address", "s_phone", "total_revenue"]]
+        .sort_values("s_suppkey").reset_index(drop=True)
+    )
+
+
+def q17(t):
+    l, p = t["lineitem"], t["part"]
+    d = p[(p.p_brand == "Brand#23") & (p.p_container == "MED BOX")]
+    j = l.merge(d, left_on="l_partkey", right_on="p_partkey")
+    avg_qty = l.groupby("l_partkey")["l_quantity"].mean().rename("avg_q")
+    j = j.join(avg_qty, on="l_partkey")
+    j = j[j.l_quantity < 0.2 * j.avg_q]
+    val = j.l_extendedprice.sum() / 7.0 if len(j) else np.nan
+    return pd.DataFrame({"avg_yearly": [val]})
+
+
+def q20(t):
+    s, n, ps, p, l = (t["supplier"], t["nation"], t["partsupp"], t["part"],
+                      t["lineitem"])
+    green = p[p.p_name.str.startswith("green")].p_partkey
+    d = l[(l.l_shipdate >= _D("1994-01-01")) & (l.l_shipdate < _D("1995-01-01"))]
+    qty = (
+        d.groupby(["l_partkey", "l_suppkey"])["l_quantity"].sum()
+        .reset_index(name="sumq")
+    )
+    j = ps[ps.ps_partkey.isin(green)].merge(
+        qty, left_on=["ps_partkey", "ps_suppkey"],
+        right_on=["l_partkey", "l_suppkey"],
+    )
+    good = j[j.ps_availqty > 0.5 * j.sumq].ps_suppkey.unique()
+    out = s[s.s_suppkey.isin(good)].merge(
+        n, left_on="s_nationkey", right_on="n_nationkey"
+    )
+    out = out[out.n_name == "CANADA"][["s_name", "s_address"]]
+    return out.sort_values("s_name").reset_index(drop=True)
+
+
+ORACLES = {
+    "q1": q1, "q2": q2, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q7": q7,
+    "q8": q8, "q9": q9, "q10": q10, "q11": q11, "q12": q12, "q13": q13,
+    "q14": q14, "q15": q15, "q16": q16, "q17": q17, "q18": q18, "q19": q19,
+    "q20": q20, "q22": q22,
+}

@@ -1,0 +1,84 @@
+"""SQL semantics regression tests (outer joins, NOT IN NULLs, subqueries)."""
+
+import numpy as np
+import pytest
+
+from ballista_tpu import schema, Int64, Utf8
+from ballista_tpu.client import BallistaContext
+
+
+@pytest.fixture()
+def ctx():
+    c = BallistaContext.standalone()
+    c.register_memtable(
+        "cust", schema(("ckey", Int64), ("cname", Utf8)),
+        {"ckey": [1, 2, 3], "cname": ["a", "b", "c"]}, primary_key="ckey",
+    )
+    c.register_memtable(
+        "ords", schema(("okey", Int64), ("ockey", Int64), ("od", Int64)),
+        {"okey": [10, 11, 12], "ockey": [1, 1, 2], "od": [5, 15, 25]},
+        primary_key="okey",
+    )
+    return c
+
+
+def test_left_join_where_filter_runs_post_join(ctx):
+    # WHERE on the right table must eliminate null-extended rows
+    out = ctx.sql(
+        "select ckey, okey from cust left join ords on ckey = ockey "
+        "where od >= 10 order by ckey, okey"
+    ).collect()
+    assert list(out["ckey"]) == [1, 2]
+    assert list(out["okey"]) == [11, 12]
+    # ON-clause filter keeps unmatched left rows (null-extended)
+    out2 = ctx.sql(
+        "select ckey, okey from cust left join ords on ckey = ockey "
+        "and od >= 10 order by ckey"
+    ).collect()
+    assert list(out2["ckey"]) == [1, 2, 3]
+    assert np.isnan(out2["okey"][2])
+
+
+def test_right_join_preserves_right(ctx):
+    out = ctx.sql(
+        "select ckey, okey from ords right join cust on ockey = ckey "
+        "order by ckey"
+    ).collect()
+    # every customer survives, incl. 3 with no order
+    assert sorted(out["ckey"]) == [1, 1, 2, 3]
+
+
+def test_not_in_subquery_null_semantics(ctx):
+    ctx.register_memtable(
+        "vals", schema(("v", Int64)), {"v": [1, 99]},
+    )
+    # no NULLs in the subquery: plain anti-join behavior
+    out = ctx.sql(
+        "select ckey from cust where ckey not in (select v from vals)"
+    ).collect()
+    assert sorted(out["ckey"]) == [2, 3]
+    # NULL in the subquery output -> NOT IN never true -> empty
+    out2 = ctx.sql(
+        "select ckey from cust where ckey not in "
+        "(select max(od) from ords where od > 100 group by okey)"
+    ).collect()
+    # subquery yields no rows at all here -> NOT IN over empty set is TRUE
+    assert sorted(out2["ckey"]) == [1, 2, 3]
+
+
+def test_scalar_subquery_empty_is_null(ctx):
+    out = ctx.sql(
+        "select ckey from cust where ckey > "
+        "(select od from ords where od > 1000)"
+    ).collect()
+    assert len(out) == 0  # NULL comparison is never true
+
+
+def test_correlated_scalar_subquery(ctx):
+    # customers whose smallest order date is < 10
+    out = ctx.sql(
+        "select ckey from cust where ckey = (select min(ockey) from ords "
+        "where ockey = ckey) and 5 >= (select min(od) from ords "
+        "where ockey = ckey) order by ckey"
+    ).collect()
+    assert list(out["ckey"]) == [1]
