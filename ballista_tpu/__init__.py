@@ -16,12 +16,20 @@ Layering (mirrors reference SURVEY layer map, bottom-up):
   client              - BallistaContext / DataFrame API (L5/L6)
 """
 
+import os as _os
+
 import jax as _jax
 
 # Exact decimal arithmetic uses scaled int64 columns; without x64, JAX would
 # silently downcast them to int32. Float64 device arrays are never created
 # (the engine stores logical f64 as f32 on device; see datatypes.py).
 _jax.config.update("jax_enable_x64", True)
+
+# Honor JAX_PLATFORMS even when an interpreter-level sitecustomize already
+# imported jax with a different value baked in (the env var is only read at
+# import time; the config update below is what actually switches platform).
+if _os.environ.get("JAX_PLATFORMS"):
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
 
 BALLISTA_TPU_VERSION = "0.1.0"
 

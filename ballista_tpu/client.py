@@ -91,15 +91,19 @@ class BallistaContext:
 
     def register_csv(self, name: str, path: str, schema: Schema,
                      has_header: bool = True,
-                     primary_key: Optional[str] = None, **kw) -> None:
+                     primary_key: Optional[str] = None, cached: bool = False,
+                     **kw) -> None:
         self.register_source(
-            name, CsvSource(path, schema, has_header=has_header, **kw), primary_key
+            name, CsvSource(path, schema, has_header=has_header, **kw),
+            primary_key, cached=cached,
         )
 
     def register_parquet(self, name: str, path: str,
                          schema: Optional[Schema] = None,
-                         primary_key: Optional[str] = None, **kw) -> None:
-        self.register_source(name, ParquetSource(path, schema, **kw), primary_key)
+                         primary_key: Optional[str] = None,
+                         cached: bool = False, **kw) -> None:
+        self.register_source(name, ParquetSource(path, schema, **kw),
+                             primary_key, cached=cached)
 
     def register_memtable(self, name: str, schema: Schema, data: Dict,
                           num_partitions: int = 1,

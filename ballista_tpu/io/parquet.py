@@ -128,7 +128,16 @@ class ParquetSource(TableSource):
                 vals = colarr.cast("float64").to_numpy(zero_copy_only=False)
                 arrays[name] = decimal_to_scaled(vals, field.dtype.scale)
             elif field.dtype.kind == "date32":
-                arrays[name] = colarr.cast("int32").to_numpy(zero_copy_only=False)
+                import pyarrow as pa
+
+                # files may store dates as date32 OR timestamps (pandas
+                # writers); normalize through date32 -> days-since-epoch
+                arr = colarr
+                if not pa.types.is_date32(arr.type):
+                    arr = arr.cast(pa.date32())
+                arrays[name] = arr.cast(pa.int32()).to_numpy(
+                    zero_copy_only=False
+                )
             else:
                 arrays[name] = colarr.to_numpy(zero_copy_only=False).astype(
                     field.dtype.device_dtype()

@@ -1,0 +1,140 @@
+"""TPC-H benchmark CLI.
+
+(reference: rust/benchmarks/tpch/src/main.rs:97-265 — ``tpch benchmark``
+runs queries N times through a context and reports per-iteration + avg ms;
+``tpch convert`` rewrites .tbl into csv/parquet with repartitioning.)
+
+Usage:
+  python -m benchmarks.tpch.main benchmark --path DATA_DIR --query 1 \
+      [--iterations 3] [--host H --port P] [--cached] [--debug]
+  python -m benchmarks.tpch.main convert --input DIR --output DIR \
+      --format parquet [--partitions N]
+  python -m benchmarks.tpch.main gen --output DIR --scale 0.01 [--parts 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def cmd_benchmark(args) -> int:
+    from ballista_tpu.client import BallistaContext
+    from .schema_def import register_tpch
+
+    if args.host:
+        ctx = BallistaContext.remote(args.host, args.port,
+                                     **{"batch.size": str(args.batch_size)})
+    else:
+        ctx = BallistaContext.standalone()
+    register_tpch(ctx, args.path, args.format, cached=args.cached)
+
+    qdir = os.path.join(os.path.dirname(__file__), "queries")
+    sql = open(os.path.join(qdir, f"q{args.query}.sql")).read()
+    if args.debug:
+        print(sql)
+        print(ctx.sql(sql).explain())
+
+    times = []
+    out = None
+    for i in range(args.iterations):
+        t0 = time.time()
+        out = ctx.sql(sql).collect()
+        ms = 1000 * (time.time() - t0)
+        times.append(ms)
+        print(f"Query {args.query} iteration {i} took {ms:.1f} ms")
+    print(f"Query {args.query} avg time: {sum(times)/len(times):.2f} ms")
+    if args.debug and out is not None:
+        print(out.to_string())
+    return 0
+
+
+def cmd_convert(args) -> int:
+    """Rewrite .tbl data to csv/parquet via the engine's scan + pyarrow."""
+    from .schema_def import TPCH_SCHEMAS
+    from ballista_tpu.io import TblSource
+    import numpy as np
+
+    os.makedirs(args.output, exist_ok=True)
+    for name, sch in TPCH_SCHEMAS.items():
+        src_path = os.path.join(args.input, name)
+        if not os.path.exists(src_path):
+            src_path = os.path.join(args.input, f"{name}.tbl")
+            if not os.path.exists(src_path):
+                print(f"skipping {name}: not found", file=sys.stderr)
+                continue
+        src = TblSource(src_path, sch)
+        frames = []
+        for p in range(src.num_partitions()):
+            for batch in src.scan(p):
+                frames.append(batch.to_pydict())
+        import pandas as pd
+
+        df = pd.concat([pd.DataFrame(f) for f in frames], ignore_index=True)
+        n_parts = max(args.partitions, 1)
+        per = -(-len(df) // n_parts)
+        out_dir = os.path.join(args.output, name)
+        os.makedirs(out_dir, exist_ok=True)
+        for p in range(n_parts):
+            chunk = df.iloc[p * per : (p + 1) * per]
+            if chunk.empty and p > 0:
+                continue
+            if args.format == "parquet":
+                chunk.to_parquet(
+                    os.path.join(out_dir, f"part-{p}.parquet"), index=False
+                )
+            else:
+                chunk.to_csv(
+                    os.path.join(out_dir, f"part-{p}.csv"), index=False
+                )
+        print(f"converted {name}: {len(df)} rows -> {out_dir}")
+    return 0
+
+
+def cmd_gen(args) -> int:
+    from . import datagen
+
+    t0 = time.time()
+    datagen.generate(args.output, args.scale, args.parts)
+    print(f"generated scale {args.scale} in {time.time()-t0:.1f}s at "
+          f"{args.output}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpch")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    b = sub.add_parser("benchmark")
+    b.add_argument("--path", required=True)
+    b.add_argument("--format", default="tbl", choices=["tbl", "csv", "parquet"])
+    b.add_argument("--query", type=int, required=True)
+    b.add_argument("--iterations", type=int, default=3)
+    b.add_argument("--host", default="")
+    b.add_argument("--port", type=int, default=50050)
+    b.add_argument("--batch-size", type=int, default=1 << 20)
+    b.add_argument("--cached", action="store_true")
+    b.add_argument("--debug", action="store_true")
+    b.set_defaults(fn=cmd_benchmark)
+
+    c = sub.add_parser("convert")
+    c.add_argument("--input", required=True)
+    c.add_argument("--output", required=True)
+    c.add_argument("--format", default="parquet", choices=["csv", "parquet"])
+    c.add_argument("--partitions", type=int, default=1)
+    c.set_defaults(fn=cmd_convert)
+
+    g = sub.add_parser("gen")
+    g.add_argument("--output", required=True)
+    g.add_argument("--scale", type=float, default=0.01)
+    g.add_argument("--parts", type=int, default=2)
+    g.set_defaults(fn=cmd_gen)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

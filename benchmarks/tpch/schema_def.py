@@ -62,7 +62,8 @@ TPCH_PKS = {
 }
 
 
-def register_tpch(ctx, data_dir: str, fmt: str = "tbl", **kw):
+def register_tpch(ctx, data_dir: str, fmt: str = "tbl", cached: bool = False,
+                  **kw):
     import os
 
     for name, sch in TPCH_SCHEMAS.items():
@@ -70,8 +71,11 @@ def register_tpch(ctx, data_dir: str, fmt: str = "tbl", **kw):
         if not os.path.exists(path):
             path = os.path.join(data_dir, f"{name}.{fmt}")
         if fmt == "tbl":
-            ctx.register_tbl(name, path, sch, primary_key=TPCH_PKS[name], **kw)
+            ctx.register_tbl(name, path, sch, primary_key=TPCH_PKS[name],
+                             cached=cached, **kw)
         elif fmt == "parquet":
-            ctx.register_parquet(name, path, sch, primary_key=TPCH_PKS[name], **kw)
+            ctx.register_parquet(name, path, sch, primary_key=TPCH_PKS[name],
+                                 cached=cached, **kw)
         else:
-            ctx.register_csv(name, path, sch, primary_key=TPCH_PKS[name], **kw)
+            ctx.register_csv(name, path, sch, primary_key=TPCH_PKS[name],
+                             cached=cached, **kw)

@@ -1,0 +1,9 @@
+#!/bin/sh
+set -e
+ROLE="$1"; shift || true
+case "$ROLE" in
+  scheduler) exec python -m ballista_tpu.distributed.scheduler_main "$@";;
+  executor)  exec python -m ballista_tpu.distributed.executor_main "$@";;
+  tpch)      exec python -m benchmarks.tpch.main "$@";;
+  *) echo "usage: scheduler|executor|tpch [args...]" >&2; exit 2;;
+esac
