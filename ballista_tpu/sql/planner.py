@@ -258,10 +258,12 @@ class SqlPlanner:
                 continue
             if isinstance(node, ex.Exists):
                 negated = neg or node.negated
-                plan_, oc, ic, how = self._decorrelate_exists(
+                plan_, on_pairs, how, pred = self._decorrelate_exists(
                     node.query, relations, col_owner, negated
                 )
-                specs.append((plan_, [(oc, ic)], how, False))
+                specs.append((plan_, on_pairs, how, False))
+                if pred is not None:
+                    remaining.append(pred)
                 continue
             # correlated scalar subquery comparison: expr OP (SELECT agg ...)
             handled = self._try_correlated_scalar(
@@ -339,12 +341,26 @@ class SqlPlanner:
 
     def _decorrelate_exists(self, sub_q: Query, outer_relations, outer_owner,
                             negated: bool):
-        """EXISTS with equality correlation -> semi/anti join spec."""
+        """EXISTS decorrelation.
+
+        Returns (plan, on_pairs, how, residual_pred_or_None).
+
+        Equality-only correlation -> plain semi/anti join (pred None).
+
+        One extra ``inner_col <> outer_col`` correlated conjunct (the q21
+        shape) -> group the inner rows by the equality key computing
+        count(val)/min(val)/max(val) of the <>-column (count of NON-NULL
+        values, so all-NULL groups behave like SQL's unknown comparisons),
+        LEFT JOIN that derived table, and test via min/max:
+          EXISTS     <=> __c > 0 AND (__mn <> x OR __mx <> x)
+          NOT EXISTS <=> __c IS NULL OR __c = 0 OR (__mn = x AND __mx = x)
+        """
         from ..optimizer import conjoin, split_conjuncts
 
         inner_rels = self._resolve_relations(sub_q)
         inner_owner = self._column_owners(inner_rels)
         corr_edges: List[Tuple[str, str]] = []  # (outer_col, inner_col)
+        neq_edges: List[Tuple[str, str]] = []  # (outer_col, inner_col)
         inner_conjs: List[ex.Expr] = []
         if sub_q.where is not None:
             for c in split_conjuncts(sub_q.where):
@@ -352,35 +368,94 @@ class SqlPlanner:
                                               outer_relations, outer_owner)
                 if edge is not None:
                     corr_edges.append(edge)
-                else:
-                    inner_conjs.append(
-                        self._qualify(c, inner_rels, inner_owner)
-                    )
+                    continue
+                nedge = self._correlation_edge(
+                    c, inner_rels, inner_owner, outer_relations, outer_owner,
+                    op="!=",
+                )
+                if nedge is not None:
+                    neq_edges.append(nedge)
+                    continue
+                inner_conjs.append(self._qualify(c, inner_rels, inner_owner))
         if not corr_edges:
             raise SqlError(
                 "EXISTS subquery without equality correlation unsupported"
             )
         if len(corr_edges) > 1:
             raise SqlError("multi-column EXISTS correlation (round 2)")
-        # plan the inner query body: join chain + residual filters
-        inner_q = Query(
-            items=[SelectItem(ex.ColumnRef(corr_edges[0][1]), None)],
-            from_table=sub_q.from_table, joins=sub_q.joins, where=None,
-            group_by=[], having=None, order_by=[], limit=None,
-        )
-        plan, remaining = self._plan_joins(
-            inner_q, inner_rels, inner_owner, inner_conjs, []
+        if len(neq_edges) > 1:
+            raise SqlError("multiple <> correlations in EXISTS (round 2)")
+        outer_col, inner_col = corr_edges[0]
+
+        if not neq_edges:
+            # plain semi/anti join
+            inner_q = Query(
+                items=[SelectItem(ex.ColumnRef(inner_col), None)],
+                from_table=sub_q.from_table, joins=sub_q.joins, where=None,
+                group_by=[], having=None, order_by=[], limit=None,
+            )
+            plan, remaining = self._plan_joins(
+                inner_q, inner_rels, inner_owner, inner_conjs, []
+            )
+            if remaining:
+                plan = Filter(conjoin(remaining), plan)
+            plan = Projection([ex.ColumnRef(inner_col)], plan)
+            return (plan, [(outer_col, inner_col)],
+                    "anti" if negated else "semi", None)
+
+        # generalized (q21): derived per-key count/min/max of the <> column
+        neq_outer, neq_inner = neq_edges[0]
+        self._corr_counter = getattr(self, "_corr_counter", 0) + 1
+        n = self._corr_counter
+        ck, cc, mn, mx = (f"__ex_key{n}", f"__ex_cnt{n}", f"__ex_min{n}",
+                          f"__ex_max{n}")
+        body, remaining = self._plan_joins(
+            Query(items=[], from_table=sub_q.from_table, joins=sub_q.joins,
+                  where=None, group_by=[], having=None, order_by=[],
+                  limit=None),
+            inner_rels, inner_owner, inner_conjs, [],
         )
         if remaining:
-            plan = Filter(conjoin(remaining), plan)
-        outer_col, inner_col = corr_edges[0]
-        plan = Projection([ex.ColumnRef(inner_col)], plan)
-        return (plan, outer_col, inner_col, "anti" if negated else "semi")
+            body = Filter(conjoin(remaining), body)
+        derived = Aggregate(
+            [ex.ColumnRef(inner_col).alias(ck)],
+            [
+                # count of NON-NULL <>-values: all-NULL groups compare
+                # unknown in SQL, matching cc = 0 here
+                ex.count(ex.ColumnRef(neq_inner)).alias(cc),
+                ex.min_(ex.ColumnRef(neq_inner)).alias(mn),
+                ex.max_(ex.ColumnRef(neq_inner)).alias(mx),
+            ],
+            body,
+        )
+        x = ex.ColumnRef(neq_outer)
+        zero = ex.Literal(0, ex.Int64)
+        if negated:
+            pred = ex.BinaryExpr(
+                ex.BinaryExpr(
+                    ex.IsNull(ex.ColumnRef(cc)), "or",
+                    ex.BinaryExpr(ex.ColumnRef(cc), "=", zero),
+                ),
+                "or",
+                ex.BinaryExpr(
+                    ex.BinaryExpr(ex.ColumnRef(mn), "=", x), "and",
+                    ex.BinaryExpr(ex.ColumnRef(mx), "=", x),
+                ),
+            )
+        else:
+            pred = ex.BinaryExpr(
+                ex.BinaryExpr(ex.ColumnRef(cc), ">", zero), "and",
+                ex.BinaryExpr(
+                    ex.BinaryExpr(ex.ColumnRef(mn), "!=", x), "or",
+                    ex.BinaryExpr(ex.ColumnRef(mx), "!=", x),
+                ),
+            )
+        return (derived, [(outer_col, ck)], "left", pred)
 
     def _correlation_edge(self, c, inner_rels, inner_owner, outer_rels,
-                          outer_owner):
-        """outer_col = inner_col equality conjunct, else None."""
-        if not (isinstance(c, ex.BinaryExpr) and c.op == "="):
+                          outer_owner, op: str = "="):
+        """outer_col OP inner_col cross-scope conjunct, else None."""
+        if not (isinstance(c, ex.BinaryExpr) and c.op == op):
             return None
         sides = [c.left, c.right]
         if not all(isinstance(s, ex.ColumnRef) for s in sides):

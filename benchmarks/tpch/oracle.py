@@ -445,9 +445,33 @@ def q20(t):
     return out.sort_values("s_name").reset_index(drop=True)
 
 
+def q21(t):
+    s_, l, o, n = t["supplier"], t["lineitem"], t["orders"], t["nation"]
+    late = l[l.l_receiptdate > l.l_commitdate]
+    # per order: distinct suppliers among all / among late lineitems
+    nsupp = l.groupby("l_orderkey")["l_suppkey"].nunique()
+    nlate = late.groupby("l_orderkey")["l_suppkey"].nunique()
+    j = (
+        late.merge(o[o.o_orderstatus == "F"], left_on="l_orderkey",
+                   right_on="o_orderkey")
+        .merge(s_, left_on="l_suppkey", right_on="s_suppkey")
+        .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+    )
+    j = j[j.n_name == "SAUDI ARABIA"]
+    j = j.join(nsupp.rename("nsupp"), on="l_orderkey")
+    j = j.join(nlate.rename("nlate"), on="l_orderkey")
+    # exists other-supplier lineitem; no other-supplier LATE lineitem
+    j = j[(j.nsupp >= 2) & (j.nlate == 1)]
+    return (
+        j.groupby("s_name").size().reset_index(name="numwait")
+        .sort_values(["numwait", "s_name"], ascending=[False, True])
+        .head(100).reset_index(drop=True)
+    )
+
+
 ORACLES = {
     "q1": q1, "q2": q2, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q7": q7,
     "q8": q8, "q9": q9, "q10": q10, "q11": q11, "q12": q12, "q13": q13,
     "q14": q14, "q15": q15, "q16": q16, "q17": q17, "q18": q18, "q19": q19,
-    "q20": q20, "q22": q22,
+    "q20": q20, "q21": q21, "q22": q22,
 }

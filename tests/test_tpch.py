@@ -18,7 +18,7 @@ from benchmarks.tpch.schema_def import register_tpch
 # (rust/benchmarks/tpch/run.sh:6-9); we assert a much wider set
 QUERIES = ["q1", "q2", "q3", "q4", "q5", "q6", "q7", "q8", "q9", "q10",
            "q11", "q12", "q13", "q14", "q15", "q16", "q17", "q18", "q19",
-           "q20", "q22"]
+           "q20", "q21", "q22"]
 QDIR = os.path.join(os.path.dirname(__file__), "..", "benchmarks", "tpch", "queries")
 
 
