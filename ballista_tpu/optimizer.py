@@ -62,18 +62,33 @@ def split_disjuncts(e: ex.Expr) -> List[ex.Expr]:
     return [e]
 
 
+def _structural_key(e: ex.Expr) -> str:
+    """Structural identity INCLUDING table qualifiers (display name() drops
+    them, which would wrongly equate n1.n_name with n2.n_name)."""
+    if isinstance(e, ex.ColumnRef):
+        return f"col:{e.qualified()}"
+    parts = [type(e).__name__]
+    for attr in ("op", "alias_name", "pattern", "negated", "fn", "value",
+                 "dtype", "ascending", "is_star"):
+        if hasattr(e, attr):
+            parts.append(repr(getattr(e, attr)))
+    for c in e.children():
+        parts.append(_structural_key(c))
+    return "(" + " ".join(parts) + ")"
+
+
 def factor_or(e: ex.Expr) -> List[ex.Expr]:
     """(A and X) or (A and Y) -> [A, (X or Y)].
 
     Pulls conjuncts common to every OR branch to the top (matched by
-    display name). TPC-H q19's OR-of-ANDs hides its join condition this
-    way; factoring exposes it to the join-graph extractor.
+    qualifier-aware structural key). TPC-H q19's OR-of-ANDs hides its join
+    condition this way; factoring exposes it to the join-graph extractor.
     """
     branches = split_disjuncts(e)
     if len(branches) < 2:
         return [e]
     branch_sets = [
-        {c.name(): c for c in split_conjuncts(b)} for b in branches
+        {_structural_key(c): c for c in split_conjuncts(b)} for b in branches
     ]
     common_names = set(branch_sets[0])
     for s in branch_sets[1:]:

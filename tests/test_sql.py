@@ -82,3 +82,25 @@ def test_correlated_scalar_subquery(ctx):
         "where ockey = ckey) order by ckey"
     ).collect()
     assert list(out["ckey"]) == [1]
+
+
+def test_factor_or_respects_qualifiers():
+    # (n1.x='A' and n2.x='B') or (n1.x='B' and n2.x='A') must NOT collapse:
+    # the qualifier distinguishes structurally identical display names
+    from ballista_tpu import expr as ex
+    from ballista_tpu.optimizer import factor_or
+
+    n1x = ex.ColumnRef("x", "n1")
+    n2x = ex.ColumnRef("x", "n2")
+    b1 = ex.BinaryExpr(ex.BinaryExpr(n1x, "=", ex.lit("A")), "and",
+                       ex.BinaryExpr(n2x, "=", ex.lit("B")))
+    b2 = ex.BinaryExpr(ex.BinaryExpr(n1x, "=", ex.lit("B")), "and",
+                       ex.BinaryExpr(n2x, "=", ex.lit("A")))
+    out = factor_or(ex.BinaryExpr(b1, "or", b2))
+    assert len(out) == 1  # nothing common: the OR survives intact
+    # and a genuinely common conjunct still factors
+    common = ex.BinaryExpr(ex.ColumnRef("k", "t"), "=", ex.lit(1))
+    c1 = ex.BinaryExpr(common, "and", ex.BinaryExpr(n1x, "=", ex.lit("A")))
+    c2 = ex.BinaryExpr(common, "and", ex.BinaryExpr(n1x, "=", ex.lit("B")))
+    out2 = factor_or(ex.BinaryExpr(c1, "or", c2))
+    assert len(out2) == 2
