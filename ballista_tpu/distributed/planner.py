@@ -63,7 +63,18 @@ class DistributedPlanner:
             stages.extend(c_stages)
             new_children.append(c_plan)
 
-        if isinstance(plan, MergeExec) or isinstance(plan, RepartitionExec):
+        if isinstance(plan, RepartitionExec):
+            # executor-level hash-partitioned shuffle writes (one file per
+            # (producer task, consumer partition)) are not implemented yet;
+            # the in-process RepartitionExec masks would silently return
+            # partition-local results if distributed, so refuse loudly
+            raise PlanError(
+                "RepartitionExec in a distributed plan is not supported yet "
+                "(round 2: hash-partitioned stage writes); use the in-mesh "
+                "all_to_all path or drop the explicit repartition"
+            )
+
+        if isinstance(plan, MergeExec):
             # child becomes a stage; this node reads its shuffled output
             child = new_children[0]
             stage = QueryStageExec(job_id, self._new_stage_id(), child)
@@ -71,15 +82,9 @@ class DistributedPlanner:
             unresolved = UnresolvedShuffleExec(
                 [stage.stage_id],
                 child.output_schema(),
-                child.output_partitioning().num_partitions
-                if isinstance(plan, MergeExec)
-                else plan.num_partitions,
+                child.output_partitioning().num_partitions,
             )
-            if isinstance(plan, MergeExec):
-                return plan.with_new_children([unresolved]), stages
-            # Repartition's shuffle write happens in the producing stage;
-            # the consumer just reads the repartitioned outputs
-            return unresolved, stages
+            return plan.with_new_children([unresolved]), stages
 
         if isinstance(plan, HashAggregateExec) and plan.mode == "final":
             child = new_children[0]

@@ -250,3 +250,62 @@ def test_cluster_join_query(cluster, tmp_path):
     exp = fact_df.groupby("cat").v.sum().reset_index().sort_values("cat")
     np.testing.assert_array_equal(got["cat"], exp["cat"])
     np.testing.assert_allclose(got["sv"], exp["v"], rtol=1e-9)
+
+
+def test_repartition_rejected_in_distributed_plans(tmp_path):
+    """Hash-repartition stage writes are round-2; the planner must refuse
+    rather than silently return partition-local results."""
+    from ballista_tpu.distributed.planner import DistributedPlanner
+    from ballista_tpu.errors import PlanError
+    from ballista_tpu.execution import plan_logical
+    from ballista_tpu.logical import LogicalPlanBuilder
+    from ballista_tpu import col
+
+    src = _mem_table(tmp_path)
+    plan = (
+        LogicalPlanBuilder.scan("t", src)
+        .repartition(4, [col("a")])
+        .build()
+    )
+    phys = plan_logical(plan)
+    with pytest.raises(PlanError, match="RepartitionExec"):
+        DistributedPlanner().plan_query_stages("j", phys)
+
+
+def test_produce_diagram(tmp_path):
+    from ballista_tpu.distributed.planner import DistributedPlanner
+    from ballista_tpu.execution import plan_logical
+    from ballista_tpu.logical import LogicalPlanBuilder
+    from ballista_tpu.utils import produce_diagram
+    from ballista_tpu import col, sum_
+
+    src = _mem_table(tmp_path)
+    plan = (
+        LogicalPlanBuilder.scan("t", src)
+        .aggregate([col("c")], [sum_(col("b")).alias("s")])
+        .build()
+    )
+    stages = DistributedPlanner().plan_query_stages("j1", plan_logical(plan))
+    dot = produce_diagram(stages)
+    assert dot.startswith("digraph G {") and dot.endswith("}")
+    assert "HashAggregateExec" in dot and "Stage" in dot
+    # cross-stage dashed edge from producer into the shuffle reader
+    assert "style=dashed" in dot
+
+
+def test_cluster_task_failure_fails_job(cluster, tmp_path):
+    """A task that errors at scan time must fail the job with the error
+    surfaced to the client (reference: any failed task fails the job,
+    state/mod.rs:342-346)."""
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.errors import ClusterError
+    from ballista_tpu.io import TblSource
+    from ballista_tpu import schema, Int64
+
+    p = tmp_path / "bad.tbl"
+    p.write_text("1|\nnot-a-number|\n")  # parse error at execution time
+    src = TblSource(str(p), schema(("a", Int64)))
+    ctx = BallistaContext.remote("localhost", cluster.port)
+    ctx.register_source("bad", src)
+    with pytest.raises(ClusterError, match="failed"):
+        ctx.sql("select sum(a) as s from bad").collect()
