@@ -80,6 +80,19 @@ class Dictionary:
         uniq, codes = np.unique(np.asarray(strings, dtype=object), return_inverse=True)
         return Dictionary(uniq), codes.astype(np.int32)
 
+    def stable_hashes(self) -> np.ndarray:
+        """int64 FNV-1a hash per dictionary value — STABLE across processes
+        and dictionary encodings, so hash partitioning of utf8 columns
+        agrees between independent producers (codes are producer-local;
+        string hashes are not)."""
+        out = np.empty(len(self.values), dtype=np.int64)
+        for i, v in enumerate(self.values):
+            h = 0xCBF29CE484222325
+            for b in str(v).encode("utf-8"):
+                h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+            out[i] = np.int64(np.uint64(h))
+        return out
+
     @staticmethod
     def canonicalize(values: Sequence[str]) -> Tuple["Dictionary", np.ndarray]:
         """Sorted-unique dictionary + old-code -> new-code remap table.
@@ -375,6 +388,18 @@ def decode_physical_array(
     if has_nulls:
         out[null_mask] = np.nan
     return out
+
+
+def empty_batch(schema) -> "ColumnBatch":
+    """Zero-row batch with the given schema (utf8 columns get empty
+    dictionaries so IPC encoding works)."""
+    return ColumnBatch.from_numpy(
+        schema,
+        {f.name: np.zeros(0, f.dtype.device_dtype()) for f in schema.fields},
+        {f.name: Dictionary([]) for f in schema.fields
+         if f.dtype.kind == "utf8"},
+        capacity=8,
+    )
 
 
 def concat_pydicts(parts: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:

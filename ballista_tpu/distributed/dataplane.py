@@ -34,6 +34,20 @@ def partition_path(work_dir: str, job_id: str, stage_id: int,
                         "data.arrow")
 
 
+def shuffle_file_name(output_partition: int) -> str:
+    # single source of truth for the shuffle file naming scheme (the C++
+    # server mirrors it; see shuffle_server.cpp)
+    return f"shuffle-{output_partition}.arrow"
+
+
+def shuffle_path(work_dir: str, job_id: str, stage_id: int,
+                 producer_partition: int, output_partition: int) -> str:
+    # hash-shuffled stages write one file per consumer partition
+    return os.path.join(work_dir, job_id, str(stage_id),
+                        str(producer_partition),
+                        shuffle_file_name(output_partition))
+
+
 # ---------------------------------------------------------------------------
 # Client
 # ---------------------------------------------------------------------------
@@ -50,11 +64,18 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def fetch_partition_bytes(host: str, port: int, job_id: str, stage_id: int,
-                          partition_id: int, timeout: float = 60.0) -> bytes:
+                          partition_id: int, timeout: float = 60.0,
+                          shuffle_output: "int | None" = None) -> bytes:
     action = pb.Action()
-    action.fetch_partition.job_id = job_id
-    action.fetch_partition.stage_id = stage_id
-    action.fetch_partition.partition_id = partition_id
+    if shuffle_output is not None:
+        action.fetch_shuffle.producer.job_id = job_id
+        action.fetch_shuffle.producer.stage_id = stage_id
+        action.fetch_shuffle.producer.partition_id = partition_id
+        action.fetch_shuffle.output_partition = shuffle_output
+    else:
+        action.fetch_partition.job_id = job_id
+        action.fetch_partition.stage_id = stage_id
+        action.fetch_partition.partition_id = partition_id
     payload = action.SerializeToString()
     with socket.create_connection((host, port), timeout=timeout) as sock:
         sock.sendall(struct.pack(">I", len(payload)) + payload)
@@ -78,12 +99,20 @@ class _Handler(socketserver.BaseRequestHandler):
             action = pb.Action()
             action.ParseFromString(_recv_exact(self.request, length))
             which = action.WhichOneof("action_type")
-            if which != "fetch_partition":
+            if which == "fetch_partition":
+                f = action.fetch_partition
+                path = partition_path(
+                    self.server.work_dir, f.job_id, f.stage_id, f.partition_id
+                )
+            elif which == "fetch_shuffle":
+                fs = action.fetch_shuffle
+                path = shuffle_path(
+                    self.server.work_dir, fs.producer.job_id,
+                    fs.producer.stage_id, fs.producer.partition_id,
+                    fs.output_partition,
+                )
+            else:
                 raise IoError(f"unsupported data-plane action {which}")
-            f = action.fetch_partition
-            path = partition_path(
-                self.server.work_dir, f.job_id, f.stage_id, f.partition_id
-            )
             if not os.path.exists(path):
                 raise IoError(f"no such partition: {path}")
             with open(path, "rb") as fh:

@@ -116,10 +116,16 @@ class Executor:
         pid = PartitionId(td.task_id.job_id, td.task_id.stage_id,
                           td.task_id.partition_id)
         plan = serde.physical_from_proto(td.plan)
+        shuffle = None
+        if td.shuffle_output_partitions:
+            hash_exprs = [
+                serde.expr_from_proto(e) for e in td.shuffle_hash_exprs
+            ]
+            shuffle = (hash_exprs or None, td.shuffle_output_partitions)
 
         def work():
             try:
-                stats = self.execute_partition(pid, plan)
+                stats = self.execute_partition(pid, plan, shuffle)
                 self._report_completed(pid, stats)
             except Exception as e:  # noqa: BLE001 - task failure
                 log.exception("task %s failed", pid)
@@ -129,36 +135,68 @@ class Executor:
 
         self._pool.submit(work)
 
-    def execute_partition(self, pid: PartitionId, plan) -> dict:
+    def execute_partition(self, pid: PartitionId, plan,
+                          shuffle=None) -> dict:
         """Run one stage partition and materialize its output
-        (reference: flight_service.rs:89-192)."""
+        (reference: flight_service.rs:89-192). With ``shuffle``
+        ((hash_exprs|None, n_out)) the output is hash/round-robin split
+        into one shuffle-q file per consumer partition."""
         from ..io import ipc
 
         t0 = time.time()
         batches = list(plan.execute(pid.partition_id))
+        if shuffle is not None:
+            return self._write_shuffled(pid, plan, batches, shuffle, t0)
         path = partition_path(self.config.work_dir, pid.job_id, pid.stage_id,
                               pid.partition_id)
         if batches:
             stats = ipc.write_partition(path, batches)
         else:
             # empty partition: write an empty file with the plan schema
-            # (utf8 columns need an — empty — dictionary for IPC encode)
-            from ..columnar import ColumnBatch, Dictionary
-            import numpy as np
+            from ..columnar import empty_batch
 
-            schema = plan.output_schema()
-            empty = ColumnBatch.from_numpy(
-                schema,
-                {f.name: np.zeros(0, f.dtype.device_dtype())
-                 for f in schema.fields},
-                {f.name: Dictionary([]) for f in schema.fields
-                 if f.dtype.kind == "utf8"},
-                capacity=8,
-            )
-            stats = ipc.write_partition(path, [empty])
+            stats = ipc.write_partition(path, [empty_batch(plan.output_schema())])
         log.info("executed %s in %.1fs (%d rows)", pid.key(),
                  time.time() - t0, stats["num_rows"])
         return {**stats, "path": path}
+
+    def _write_shuffled(self, pid: PartitionId, plan, batches, shuffle,
+                        t0: float) -> dict:
+        import jax.numpy as jnp
+
+        from ..io import ipc
+        from ..kernels.expr_eval import Evaluator
+        from ..physical.operators import compute_partition_ids
+        from .dataplane import shuffle_path
+
+        hash_exprs, n_out = shuffle
+        schema = plan.output_schema()
+        ev = Evaluator(schema)
+        if not batches:
+            from ..columnar import empty_batch
+
+            batches = [empty_batch(schema)]
+        totals = {"num_rows": 0, "num_batches": 0, "num_bytes": 0}
+        masked = [[] for _ in range(n_out)]
+        offset = 0
+        for b in batches:
+            pids = compute_partition_ids(b, hash_exprs, n_out, offset, ev)
+            for q in range(n_out):
+                masked[q].append(
+                    b.with_selection(jnp.logical_and(b.selection, pids == q))
+                )
+            offset += b.num_rows_host()
+        base = None
+        for q in range(n_out):
+            path = shuffle_path(self.config.work_dir, pid.job_id,
+                                pid.stage_id, pid.partition_id, q)
+            base = path
+            st = ipc.write_partition(path, masked[q])
+            for k in totals:
+                totals[k] += st[k]
+        log.info("executed %s (shuffle x%d) in %.1fs (%d rows)", pid.key(),
+                 n_out, time.time() - t0, totals["num_rows"])
+        return {**totals, "path": base}
 
     def _report_completed(self, pid: PartitionId, stats: dict):
         ts = pb.TaskStatus()

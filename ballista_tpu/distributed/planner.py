@@ -64,14 +64,22 @@ class DistributedPlanner:
             new_children.append(c_plan)
 
         if isinstance(plan, RepartitionExec):
-            # executor-level hash-partitioned shuffle writes (one file per
-            # (producer task, consumer partition)) are not implemented yet;
-            # the in-process RepartitionExec masks would silently return
-            # partition-local results if distributed, so refuse loudly
-            raise PlanError(
-                "RepartitionExec in a distributed plan is not supported yet "
-                "(round 2: hash-partitioned stage writes); use the in-mesh "
-                "all_to_all path or drop the explicit repartition"
+            # hash-partitioned shuffle: the producing stage's tasks (one per
+            # child partition) write one shuffle-q file per consumer
+            # partition; the consumer reads the q-files of every producer
+            child = new_children[0]
+            stage = QueryStageExec(
+                job_id, self._new_stage_id(), child,
+                shuffle_hash_exprs=plan.hash_exprs,
+                shuffle_output_partitions=plan.num_partitions,
+            )
+            stages.append(stage)
+            return (
+                UnresolvedShuffleExec(
+                    [stage.stage_id], child.output_schema(),
+                    plan.num_partitions,
+                ),
+                stages,
             )
 
         if isinstance(plan, MergeExec):

@@ -82,8 +82,16 @@ class SchedulerService:
                 ]
                 nparts = stage.output_partitioning().num_partitions
                 plan_bytes = serde.physical_to_proto(stage.child).SerializeToString()
+                shuffle_spec = None
+                if stage.shuffle_output_partitions:
+                    hx = [
+                        serde.expr_to_proto(e).SerializeToString()
+                        for e in (stage.shuffle_hash_exprs or [])
+                    ]
+                    shuffle_spec = (hx, stage.shuffle_output_partitions)
                 self.state.save_stage_plan(
-                    job_id, stage.stage_id, plan_bytes, nparts, deps
+                    job_id, stage.stage_id, plan_bytes, nparts, deps,
+                    shuffle_spec,
                 )
                 for p in range(nparts):
                     self.state.save_task_status(
@@ -134,12 +142,23 @@ class SchedulerService:
 
     def _task_definition(self, task: PartitionId, meta: ExecutorMeta
                          ) -> pb.TaskDefinition:
-        plan_bytes, _, deps = self.state.get_stage_plan(task.job_id, task.stage_id)
+        plan_bytes, _, deps, shuffle_spec = self.state.get_stage_plan(
+            task.job_id, task.stage_id
+        )
         node = pb.PhysicalPlanNode()
         node.ParseFromString(plan_bytes)
         plan = serde.physical_from_proto(node)
         if deps:
             locations = self.state.stage_locations(task.job_id)
+            # expand hash-shuffled producer locations into per-consumer files
+            for dep in deps:
+                _, _, _, dep_spec = self.state.get_stage_plan(task.job_id, dep)
+                if dep_spec is not None and locations.get(dep):
+                    # (missing/empty deps stay absent so shuffle resolution
+                    # fails loudly with PlanError, not a zero-group reader)
+                    locations[dep] = _expand_shuffle_locations(
+                        locations[dep], dep_spec[1]
+                    )
             plan = remove_unresolved_shuffles(plan, locations)
         self.state.save_task_status(
             TaskStatus(task, "running", executor_id=meta.id)
@@ -149,6 +168,13 @@ class SchedulerService:
         td.task_id.stage_id = task.stage_id
         td.task_id.partition_id = task.partition_id
         td.plan.CopyFrom(serde.physical_to_proto(plan))
+        if shuffle_spec is not None:
+            hx_bytes, n_out = shuffle_spec
+            for hb in hx_bytes:
+                e = pb.LogicalExprNode()
+                e.ParseFromString(hb)
+                td.shuffle_hash_exprs.append(e)
+            td.shuffle_output_partitions = n_out
         return td
 
     # -- RPC: GetJobStatus --------------------------------------------------
@@ -195,6 +221,31 @@ class SchedulerService:
             schema=serde.schema_to_proto(src.table_schema()),
             num_partitions=src.num_partitions(),
         )
+
+
+def _expand_shuffle_locations(producer_locs, n_out: int):
+    """Per-producer completed-task locations -> one location per
+    (producer, consumer-partition) shuffle file."""
+    import os
+
+    from .dataplane import shuffle_file_name
+    from .types import PartitionLocation
+
+    out = []
+    for loc in producer_locs:
+        base = os.path.dirname(loc.path) if loc.path else ""
+        for q in range(n_out):
+            out.append(
+                PartitionLocation(
+                    job_id=loc.job_id, stage_id=loc.stage_id,
+                    partition_id=loc.partition_id,
+                    executor_id=loc.executor_id, host=loc.host,
+                    port=loc.port,
+                    path=os.path.join(base, shuffle_file_name(q)) if base else "",
+                    stats=loc.stats, shuffle_output=q,
+                )
+            )
+    return out
 
 
 def _task_status_from_proto(ts: pb.TaskStatus) -> TaskStatus:

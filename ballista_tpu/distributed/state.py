@@ -184,7 +184,8 @@ class SchedulerState:
             for k, v in stage_rows:
                 job_id, sid = k[len(prefix):].split("/")
                 sid = int(sid)
-                _, nparts, deps = pickle.loads(v)
+                row = pickle.loads(v)
+                _, nparts, deps = row[:3]
                 self._stage_deps[(job_id, sid)] = list(deps)
                 self._stage_parts[(job_id, sid)] = nparts
                 jobs.add(job_id)
@@ -229,10 +230,14 @@ class SchedulerState:
     # -- stages -------------------------------------------------------------
 
     def save_stage_plan(self, job_id: str, stage_id: int, plan_bytes: bytes,
-                        num_partitions: int, dep_stage_ids: List[int]):
+                        num_partitions: int, dep_stage_ids: List[int],
+                        shuffle_spec: "tuple | None" = None):
+        # shuffle_spec: (serialized hash expr bytes list | None, n_outputs)
         self.kv.put(
             self._k("stages", job_id, stage_id),
-            pickle.dumps((plan_bytes, num_partitions, dep_stage_ids)),
+            pickle.dumps(
+                (plan_bytes, num_partitions, dep_stage_ids, shuffle_spec)
+            ),
         )
         with self._lock:
             self._stage_deps[(job_id, stage_id)] = list(dep_stage_ids)
@@ -242,7 +247,10 @@ class SchedulerState:
         v = self.kv.get(self._k("stages", job_id, stage_id))
         if v is None:
             raise ClusterError(f"no stage plan {job_id}/{stage_id}")
-        return pickle.loads(v)  # (plan_bytes, num_partitions, deps)
+        row = pickle.loads(v)
+        if len(row) == 3:  # older rows without a shuffle spec
+            row = (*row, None)
+        return row  # (plan_bytes, num_partitions, deps, shuffle_spec)
 
     def stage_ids(self, job_id: str) -> List[int]:
         prefix = self._k("stages", job_id) + "/"

@@ -29,12 +29,14 @@ class PartitionId:
 class PartitionLocation:
     job_id: str
     stage_id: int
-    partition_id: int
+    partition_id: int  # PRODUCER partition for shuffled stages
     executor_id: str
     host: str
     port: int
     path: str = ""
     stats: Optional[Dict[str, int]] = None
+    # hash-shuffled stages: which consumer partition this file feeds
+    shuffle_output: Optional[int] = None
 
 
 @dataclass

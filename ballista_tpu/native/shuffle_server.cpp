@@ -84,12 +84,39 @@ struct FetchRequest {
   std::string job_id;
   uint32_t stage_id = 0;
   uint32_t partition_id = 0;
+  bool is_shuffle = false;
+  uint32_t output_partition = 0;
   bool valid = false;
 };
 
-// Action { oneof { ExecutePartition execute_partition = 1;
-//                  PartitionId fetch_partition = 2; string sql = 3; } }
 // PartitionId { string job_id = 1; uint32 stage_id = 2; uint32 partition_id = 3; }
+bool decode_partition_id(const uint8_t* buf, size_t len, FetchRequest* out) {
+  Reader rr{buf, buf + len};
+  while (rr.ok && rr.p < rr.end) {
+    uint64_t t2 = rr.varint();
+    uint32_t f2 = static_cast<uint32_t>(t2 >> 3);
+    uint32_t w2 = static_cast<uint32_t>(t2 & 7);
+    if (f2 == 1 && w2 == 2) {
+      uint64_t sn = rr.varint();
+      const uint8_t* sp;
+      if (!rr.bytes(sn, &sp)) break;
+      out->job_id.assign(reinterpret_cast<const char*>(sp), sn);
+    } else if (f2 == 2 && w2 == 0) {
+      out->stage_id = static_cast<uint32_t>(rr.varint());
+    } else if (f2 == 3 && w2 == 0) {
+      out->partition_id = static_cast<uint32_t>(rr.varint());
+    } else {
+      rr.skip(w2);
+    }
+  }
+  return rr.ok && !out->job_id.empty();
+}
+
+// Action { oneof { ExecutePartition execute_partition = 1;
+//                  PartitionId fetch_partition = 2; string sql = 3;
+//                  FetchShufflePartition fetch_shuffle = 4; } }
+// FetchShufflePartition { PartitionId producer = 1;
+//                         uint32 output_partition = 2; }
 FetchRequest decode_action(const uint8_t* buf, size_t len) {
   FetchRequest out;
   Reader r{buf, buf + len};
@@ -101,7 +128,14 @@ FetchRequest decode_action(const uint8_t* buf, size_t len) {
       uint64_t n = r.varint();
       const uint8_t* sub;
       if (!r.bytes(n, &sub)) break;
+      out.valid = decode_partition_id(sub, n, &out);
+    } else if (field == 4 && wt == 2) {  // fetch_shuffle submessage
+      uint64_t n = r.varint();
+      const uint8_t* sub;
+      if (!r.bytes(n, &sub)) break;
       Reader rr{sub, sub + n};
+      out.is_shuffle = true;
+      bool got_producer = false;
       while (rr.ok && rr.p < rr.end) {
         uint64_t t2 = rr.varint();
         uint32_t f2 = static_cast<uint32_t>(t2 >> 3);
@@ -110,16 +144,14 @@ FetchRequest decode_action(const uint8_t* buf, size_t len) {
           uint64_t sn = rr.varint();
           const uint8_t* sp;
           if (!rr.bytes(sn, &sp)) break;
-          out.job_id.assign(reinterpret_cast<const char*>(sp), sn);
+          got_producer = decode_partition_id(sp, sn, &out);
         } else if (f2 == 2 && w2 == 0) {
-          out.stage_id = static_cast<uint32_t>(rr.varint());
-        } else if (f2 == 3 && w2 == 0) {
-          out.partition_id = static_cast<uint32_t>(rr.varint());
+          out.output_partition = static_cast<uint32_t>(rr.varint());
         } else {
           rr.skip(w2);
         }
       }
-      out.valid = rr.ok && !out.job_id.empty();
+      out.valid = rr.ok && got_producer;
     } else {
       r.skip(wt);
     }
@@ -196,9 +228,15 @@ void* handle_conn(void* argp) {
           send_error(fd, "bad job id");
         } else {
           char path[512];
-          snprintf(path, sizeof path, "%s/%s/%u/%u/data.arrow",
-                   args->work_dir.c_str(), req.job_id.c_str(), req.stage_id,
-                   req.partition_id);
+          if (req.is_shuffle) {
+            snprintf(path, sizeof path, "%s/%s/%u/%u/shuffle-%u.arrow",
+                     args->work_dir.c_str(), req.job_id.c_str(),
+                     req.stage_id, req.partition_id, req.output_partition);
+          } else {
+            snprintf(path, sizeof path, "%s/%s/%u/%u/data.arrow",
+                     args->work_dir.c_str(), req.job_id.c_str(), req.stage_id,
+                     req.partition_id);
+          }
           FILE* f = fopen(path, "rb");
           if (!f) {
             send_error(fd, std::string("no such partition: ") + path);

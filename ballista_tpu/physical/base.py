@@ -112,14 +112,48 @@ class PipelineOp(PhysicalPlan):
 
 
 def concat_batches(schema: Schema, batches: List[ColumnBatch]) -> ColumnBatch:
-    """Concatenate batches (device) into one larger-capacity batch."""
+    """Concatenate batches (device) into one larger-capacity batch.
+
+    utf8 columns whose batches carry DIFFERENT dictionaries (e.g. shuffle
+    partitions from independent producers) are unified: a sorted union
+    dictionary is built host-side and each batch's codes are remapped.
+    Host-level only — never call inside a jit trace.
+    """
     if not batches:
         raise ExecutionError("concat of zero batches")
     if len(batches) == 1:
         return batches[0]
+    import numpy as np
+
+    from ..columnar import Dictionary
+
     cols: List[Column] = []
     for i, f in enumerate(schema.fields):
-        vals = jnp.concatenate([b.columns[i].values for b in batches])
+        values_list = [b.columns[i].values for b in batches]
+        dicts = [b.columns[i].dictionary for b in batches]
+        dict_ = next((d for d in dicts if d is not None), None)
+        if dict_ is not None and any(
+            d is not None and d is not dict_ for d in dicts
+        ):
+            # unify: sorted union + per-batch code remap
+            union = np.unique(np.concatenate(
+                [np.asarray(d.values, dtype=object) for d in dicts
+                 if d is not None]
+            ))
+            union_str = union.astype(str)
+            dict_ = Dictionary(union)
+            remapped = []
+            for d, v in zip(dicts, values_list):
+                if d is None or len(d) == 0:
+                    remapped.append(v)
+                    continue
+                remap = np.searchsorted(union_str, d.values.astype(str))
+                remapped.append(
+                    jnp.take(jnp.asarray(remap.astype(np.int32)),
+                             v.astype(jnp.int32), mode="clip")
+                )
+            values_list = remapped
+        vals = jnp.concatenate(values_list)
         vs = [b.columns[i].validity for b in batches]
         if any(v is not None for v in vs):
             validity = jnp.concatenate(
@@ -130,17 +164,6 @@ def concat_batches(schema: Schema, batches: List[ColumnBatch]) -> ColumnBatch:
             )
         else:
             validity = None
-        dict_ = next(
-            (b.columns[i].dictionary for b in batches if b.columns[i].dictionary),
-            None,
-        )
-        # all batches of a stream must share the interned table dictionary
-        for b in batches:
-            d = b.columns[i].dictionary
-            if d is not None and dict_ is not None and d is not dict_:
-                raise ExecutionError(
-                    f"cannot concat {f.name}: differing dictionaries"
-                )
         cols.append(Column(vals, f.dtype, validity, dict_))
     selection = jnp.concatenate([b.selection for b in batches])
     num_rows = sum([b.num_rows for b in batches])

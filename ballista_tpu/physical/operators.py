@@ -28,6 +28,29 @@ from ..logical import TableSource
 from .base import PhysicalPlan, PipelineOp, Partitioning, concat_batches, take_batch
 
 
+def compute_partition_ids(batch: ColumnBatch, hash_exprs, num_partitions: int,
+                          row_offset: int, evaluator: Evaluator):
+    """int32 partition id per row: chained splitmix64 over the hash exprs,
+    or round-robin by global row index. Shared by the in-process
+    RepartitionExec and the executor's shuffle writes so both planes agree.
+
+    utf8 keys hash their STRING VALUE (via per-dictionary stable FNV-1a
+    hashes), never the dictionary code — codes are producer-local and would
+    break hash co-location across independent producers."""
+    if hash_exprs:
+        h = jnp.zeros((batch.capacity,), jnp.uint64)
+        for e in hash_exprs:
+            r = evaluator.evaluate(e, batch)
+            v = jnp.broadcast_to(r.values, (batch.capacity,))
+            if r.dictionary is not None:
+                str_hashes = jnp.asarray(r.dictionary.stable_hashes())
+                v = jnp.take(str_hashes, v.astype(jnp.int32), mode="clip")
+            h = splitmix64(h ^ splitmix64(v.astype(jnp.int64)))
+        return (h % jnp.uint64(num_partitions)).astype(jnp.int32)
+    idx = row_offset + jnp.arange(batch.capacity, dtype=jnp.int32)
+    return idx % num_partitions
+
+
 class ScanExec(PhysicalPlan):
     """Table scan over a partitioned source (reference: CsvScanExecNode /
     ParquetScanExecNode, ballista.proto:334-354)."""
@@ -276,15 +299,9 @@ class RepartitionExec(PhysicalPlan):
 
     def partition_ids(self, batch: ColumnBatch, row_offset: int) -> jax.Array:
         """int32 partition id per row (traced)."""
-        if self.hash_exprs:
-            h = jnp.zeros((batch.capacity,), jnp.uint64)
-            for e in self.hash_exprs:
-                r = self._ev.evaluate(e, batch)
-                v = jnp.broadcast_to(r.values, (batch.capacity,))
-                h = splitmix64(h ^ splitmix64(v.astype(jnp.int64)))
-            return (h % jnp.uint64(self.num_partitions)).astype(jnp.int32)
-        idx = row_offset + jnp.arange(batch.capacity, dtype=jnp.int32)
-        return idx % self.num_partitions
+        return compute_partition_ids(batch, self.hash_exprs,
+                                     self.num_partitions, row_offset,
+                                     self._ev)
 
     def _materialize(self) -> List[ColumnBatch]:
         if self._cache is None:

@@ -27,12 +27,16 @@ from .base import PhysicalPlan, Partitioning
 class QueryStageExec(PhysicalPlan):
     """Stage boundary marker (reference: query_stage.rs:29-85). Execution
     (materializing output) is driven by the executor task runner, which
-    also applies the hash partitioning for the consuming stage."""
+    also applies the hash partitioning for the consuming stage when
+    ``shuffle_hash_exprs``/``shuffle_output_partitions`` are set."""
 
-    def __init__(self, job_id: str, stage_id: int, child: PhysicalPlan):
+    def __init__(self, job_id: str, stage_id: int, child: PhysicalPlan,
+                 shuffle_hash_exprs=None, shuffle_output_partitions: int = 0):
         self.job_id = job_id
         self.stage_id = stage_id
         self.child = child
+        self.shuffle_hash_exprs = shuffle_hash_exprs
+        self.shuffle_output_partitions = shuffle_output_partitions
 
     def output_schema(self) -> Schema:
         return self.child.output_schema()
@@ -44,7 +48,9 @@ class QueryStageExec(PhysicalPlan):
         return [self.child]
 
     def with_new_children(self, children):
-        return QueryStageExec(self.job_id, self.stage_id, children[0])
+        return QueryStageExec(self.job_id, self.stage_id, children[0],
+                              self.shuffle_hash_exprs,
+                              self.shuffle_output_partitions)
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
         yield from self.child.execute(partition)
@@ -85,35 +91,52 @@ class UnresolvedShuffleExec(PhysicalPlan):
 
 
 class ShuffleReaderExec(PhysicalPlan):
-    """Reads one completed shuffle partition per output partition
-    (reference: shuffle_reader.rs:33-100 — partition index maps 1:1 to a
-    PartitionLocation)."""
+    """Reads completed shuffle partitions (reference:
+    shuffle_reader.rs:33-100).
+
+    Two layouts:
+    - merge-style stages: output partition i maps 1:1 to location i;
+    - hash-shuffled stages (locations carry ``shuffle_output``): output
+      partition q reads the shuffle-q file of EVERY producer partition.
+    """
 
     def __init__(self, partition_locations: List[PartitionLocation],
                  schema: Schema):
         self.partition_locations = list(partition_locations)
         self._schema = schema
-        self._cache: Optional[List[List[ColumnBatch]]] = None
+        self._cache = {}
+        shuffled = [
+            l for l in self.partition_locations if l.shuffle_output is not None
+        ]
+        if shuffled:
+            n_out = max(l.shuffle_output for l in shuffled) + 1
+            self._groups: List[List[PartitionLocation]] = [
+                [l for l in shuffled if l.shuffle_output == q]
+                for q in range(n_out)
+            ]
+        else:
+            self._groups = [[l] for l in self.partition_locations]
 
     def output_schema(self) -> Schema:
         return self._schema
 
     def output_partitioning(self) -> Partitioning:
-        return Partitioning("unknown", max(len(self.partition_locations), 1))
+        return Partitioning("unknown", max(len(self._groups), 1))
 
     def with_new_children(self, children):
         return self
 
-    def _load_all(self) -> List[List[ColumnBatch]]:
-        """Fetch every location once; utf8 dictionaries are unioned ACROSS
-        partitions so downstream concat/compare sees one interned
-        dictionary per column (producers encode independently)."""
-        if self._cache is not None:
-            return self._cache
+    def _load_group(self, q: int) -> List[ColumnBatch]:
+        """Fetch only THIS output partition's files (a consumer task reads
+        its own group, not the whole shuffle). utf8 dictionaries are
+        unioned within the group; cross-group concat is handled by
+        concat_batches' dictionary unification."""
+        if q in self._cache:
+            return self._cache[q]
         from ..io import ipc
 
         parts = []
-        for loc in self.partition_locations:
+        for loc in self._groups[q]:
             if loc.path and os.path.exists(loc.path):
                 _, arrays, nulls, dicts, _ = ipc.read_partition_arrays(loc.path)
             else:
@@ -121,16 +144,16 @@ class ShuffleReaderExec(PhysicalPlan):
 
                 buf = fetch_partition_bytes(
                     loc.host, loc.port, loc.job_id, loc.stage_id,
-                    loc.partition_id,
+                    loc.partition_id, shuffle_output=loc.shuffle_output,
                 )
                 _, arrays, nulls, dicts, _ = ipc.read_partition_arrays(buf)
             parts.append((arrays, nulls, dicts))
         batches = ipc.batches_from_parts(self._schema, parts)
-        self._cache = [[b] for b in batches]
-        return self._cache
+        self._cache[q] = batches
+        return batches
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
-        yield from self._load_all()[partition]
+        yield from self._load_group(partition)
 
     def display(self) -> str:
         return f"ShuffleReaderExec: {len(self.partition_locations)} partitions"

@@ -504,6 +504,9 @@ def location_to_proto(loc) -> pb.PartitionLocation:
     p.executor_meta.host = loc.host
     p.executor_meta.port = loc.port
     p.path = loc.path or ""
+    if loc.shuffle_output is not None:
+        p.is_shuffle = True
+        p.shuffle_output = loc.shuffle_output
     if loc.stats is not None:
         p.partition_stats.num_rows = loc.stats.get("num_rows", 0)
         p.partition_stats.num_batches = loc.stats.get("num_batches", 0)
@@ -522,6 +525,7 @@ def location_from_proto(p: pb.PartitionLocation):
         host=p.executor_meta.host,
         port=p.executor_meta.port,
         path=p.path,
+        shuffle_output=p.shuffle_output if p.is_shuffle else None,
         stats={
             "num_rows": p.partition_stats.num_rows,
             "num_batches": p.partition_stats.num_batches,

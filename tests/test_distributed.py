@@ -252,24 +252,34 @@ def test_cluster_join_query(cluster, tmp_path):
     np.testing.assert_allclose(got["sv"], exp["v"], rtol=1e-9)
 
 
-def test_repartition_rejected_in_distributed_plans(tmp_path):
-    """Hash-repartition stage writes are round-2; the planner must refuse
-    rather than silently return partition-local results."""
-    from ballista_tpu.distributed.planner import DistributedPlanner
-    from ballista_tpu.errors import PlanError
-    from ballista_tpu.execution import plan_logical
-    from ballista_tpu.logical import LogicalPlanBuilder
-    from ballista_tpu import col
-
+def test_cluster_hash_repartition_shuffle(cluster, tmp_path):
+    """Distributed hash shuffle: a Repartition stage writes one shuffle-q
+    file per consumer partition; consumers read the q-files of every
+    producer. Results must match the unshuffled standalone run."""
     src = _mem_table(tmp_path)
-    plan = (
-        LogicalPlanBuilder.scan("t", src)
-        .repartition(4, [col("a")])
-        .build()
+    from ballista_tpu.client import BallistaContext
+
+    ctx = BallistaContext.remote("localhost", cluster.port)
+    ctx.register_source("t", src)
+    df = (
+        ctx.table("t")
+        .repartition(3, [col("c")])
+        .aggregate([col("c")], [sum_(col("b")).alias("s"),
+                                count().alias("n")])
+        .sort(col("c"))
     )
-    phys = plan_logical(plan)
-    with pytest.raises(PlanError, match="RepartitionExec"):
-        DistributedPlanner().plan_query_stages("j", phys)
+    got = df.collect()
+    import pandas as pd
+
+    a = np.arange(100)
+    exp = (
+        pd.DataFrame({"c": [f"k{i % 3}" for i in a], "b": (a % 7) + 0.25})
+        .groupby("c").agg(s=("b", "sum"), n=("b", "size")).reset_index()
+        .sort_values("c")
+    )
+    np.testing.assert_array_equal(got["c"], exp["c"])
+    np.testing.assert_allclose(got["s"], exp["s"], rtol=1e-9)
+    np.testing.assert_array_equal(got["n"], exp["n"])
 
 
 def test_produce_diagram(tmp_path):
@@ -309,3 +319,37 @@ def test_cluster_task_failure_fails_job(cluster, tmp_path):
     ctx.register_source("bad", src)
     with pytest.raises(ClusterError, match="failed"):
         ctx.sql("select sum(a) as s from bad").collect()
+
+
+def test_utf8_hash_partition_stable_across_dictionaries():
+    """Equal strings must hash to the same partition regardless of which
+    producer-local dictionary encoded them (regression: hashing codes)."""
+    import jax.numpy as jnp
+
+    from ballista_tpu.columnar import ColumnBatch, Dictionary
+    from ballista_tpu.kernels.expr_eval import Evaluator
+    from ballista_tpu.physical.operators import compute_partition_ids
+
+    s = schema(("c", Utf8))
+    d1, codes1 = Dictionary.encode(["apple", "banana"])   # banana -> 1
+    d2, codes2 = Dictionary.encode(["banana", "cherry"])  # banana -> 0
+    b1 = ColumnBatch.from_numpy(s, {"c": codes1}, {"c": d1}, capacity=8)
+    b2 = ColumnBatch.from_numpy(s, {"c": codes2}, {"c": d2}, capacity=8)
+    ev = Evaluator(s)
+    p1 = np.asarray(compute_partition_ids(b1, [col("c")], 5, 0, ev))
+    p2 = np.asarray(compute_partition_ids(b2, [col("c")], 5, 0, ev))
+    # 'banana' is row 1 in b1 and row 0 in b2
+    assert p1[1] == p2[0], "same string must land on the same partition"
+
+
+def test_concat_batches_unifies_dictionaries():
+    from ballista_tpu.columnar import ColumnBatch, Dictionary
+    from ballista_tpu.physical.base import concat_batches
+
+    s = schema(("c", Utf8))
+    d1, codes1 = Dictionary.encode(["x", "y"])
+    d2, codes2 = Dictionary.encode(["y", "z"])
+    b1 = ColumnBatch.from_numpy(s, {"c": codes1}, {"c": d1}, capacity=4)
+    b2 = ColumnBatch.from_numpy(s, {"c": codes2}, {"c": d2}, capacity=4)
+    out = concat_batches(s, [b1, b2]).to_pydict()
+    assert list(out["c"]) == ["x", "y", "y", "z"]
