@@ -26,6 +26,17 @@ from ..errors import IoError
 from ..proto import ballista_pb2 as pb
 
 
+def path_component_ok(s: str) -> bool:
+    """Network-supplied path components must be short alnum/-/_ tokens
+    (mirrors shuffle_server.cpp path_component_ok; job ids are 7-char
+    alphanumeric). Rejects traversal ('..'), separators, and absolute
+    paths (os.path.join would discard work_dir for those)."""
+    return (
+        0 < len(s) <= 128
+        and all((c.isascii() and c.isalnum()) or c in "-_" for c in s)
+    )
+
+
 def partition_path(work_dir: str, job_id: str, stage_id: int,
                    partition_id: int) -> str:
     # layout mirrors the reference's work_dir/{job}/{stage}/{part}/data.arrow
@@ -101,11 +112,13 @@ class _Handler(socketserver.BaseRequestHandler):
             which = action.WhichOneof("action_type")
             if which == "fetch_partition":
                 f = action.fetch_partition
+                job_id = f.job_id
                 path = partition_path(
                     self.server.work_dir, f.job_id, f.stage_id, f.partition_id
                 )
             elif which == "fetch_shuffle":
                 fs = action.fetch_shuffle
+                job_id = fs.producer.job_id
                 path = shuffle_path(
                     self.server.work_dir, fs.producer.job_id,
                     fs.producer.stage_id, fs.producer.partition_id,
@@ -113,6 +126,8 @@ class _Handler(socketserver.BaseRequestHandler):
                 )
             else:
                 raise IoError(f"unsupported data-plane action {which}")
+            if not path_component_ok(job_id):
+                raise IoError("bad job id")
             if not os.path.exists(path):
                 raise IoError(f"no such partition: {path}")
             with open(path, "rb") as fh:

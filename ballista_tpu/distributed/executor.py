@@ -39,8 +39,14 @@ class ExecutorConfig:
     def __init__(self, host: str = "localhost", port: int = 0,
                  work_dir: Optional[str] = None, concurrent_tasks: int = 2,
                  scheduler_host: str = "localhost",
-                 scheduler_port: int = 50050):
+                 scheduler_port: int = 50050,
+                 bind_host: Optional[str] = None):
+        # host = the address peers should dial (advertised in PollWork);
+        # bind_host = the local interface the data plane listens on.
+        # Distinct so NAT/port-forward setups can bind 0.0.0.0 while
+        # advertising an external address.
         self.host = host
+        self.bind_host = bind_host if bind_host is not None else host
         self.port = port
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-")
         self.concurrent_tasks = concurrent_tasks
@@ -53,7 +59,7 @@ class Executor:
         self.config = config
         self.id = str(uuid.uuid4())
         self._data_plane = start_data_plane(
-            config.host, config.port, config.work_dir
+            config.bind_host, config.port, config.work_dir
         )
         self.port = self._data_plane.port
         self._client = SchedulerClient(config.scheduler_host,

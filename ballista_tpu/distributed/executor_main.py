@@ -60,6 +60,7 @@ def main(argv=None) -> int:
 
     cfg = ExecutorConfig(
         host=args.external_host or args.bind_host,
+        bind_host=args.bind_host,
         port=args.port,
         work_dir=args.work_dir or None,
         concurrent_tasks=args.concurrent_tasks,

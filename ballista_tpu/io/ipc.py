@@ -121,9 +121,16 @@ def read_partition_arrays(
             kinds[name] = ("utf8", 0)
         else:
             null_mask = np.asarray(chunk.is_null())
-            vals = chunk.to_numpy(zero_copy_only=False)
-            if null_mask.any():
-                vals = np.where(null_mask, 0, np.nan_to_num(vals))
+            if pa.types.is_integer(chunk.type):
+                # stay in integer domain: to_numpy on a nullable int array
+                # converts to float64, corrupting scaled-decimal/int64
+                # values above 2^53; fill_null copies, so only when needed
+                src = chunk.fill_null(0) if null_mask.any() else chunk
+                vals = src.to_numpy(zero_copy_only=False)
+            else:
+                vals = chunk.to_numpy(zero_copy_only=False)
+                if null_mask.any():
+                    vals = np.where(null_mask, 0, np.nan_to_num(vals))
             arrays[name] = vals
             kinds[name] = (kind or str(chunk.type), scale)
         nulls[name] = null_mask

@@ -1,0 +1,91 @@
+"""Data-plane hardening and IPC fidelity tests.
+
+The reference's Flight service trusts callers inside the cluster perimeter;
+our socket data plane validates network-supplied path components the same
+way the native C++ server does (shuffle_server.cpp path_component_ok), and
+IPC reads must keep int64/scaled-decimal values exact (no float64 detours).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from ballista_tpu import schema, Int64, Utf8
+from ballista_tpu.columnar import Column, ColumnBatch
+from ballista_tpu.errors import IoError
+from ballista_tpu.io import ipc
+from ballista_tpu.distributed import dataplane
+
+
+# ---------------------------------------------------------------------------
+# path traversal rejection
+# ---------------------------------------------------------------------------
+
+
+def test_path_component_ok():
+    assert dataplane.path_component_ok("abc123-XY_z")
+    assert not dataplane.path_component_ok("")
+    assert not dataplane.path_component_ok("..")
+    assert not dataplane.path_component_ok("../other")
+    assert not dataplane.path_component_ok("/etc")
+    assert not dataplane.path_component_ok("a/b")
+    assert not dataplane.path_component_ok("a" * 129)
+
+
+def test_data_plane_rejects_traversal_job_id(tmp_path):
+    # plant a file OUTSIDE work_dir that a traversal would reach
+    secret = tmp_path / "secret" / "1" / "0" / "data.arrow"
+    secret.parent.mkdir(parents=True)
+    secret.write_bytes(b"SECRET")
+    work_dir = tmp_path / "work"
+    work_dir.mkdir()
+
+    server = dataplane.start_data_plane("localhost", 0, str(work_dir))
+    try:
+        with pytest.raises(IoError, match="bad job id"):
+            dataplane.fetch_partition_bytes(
+                "localhost", server.port, "../secret", 1, 0
+            )
+        # absolute path job ids are rejected too
+        with pytest.raises(IoError, match="bad job id"):
+            dataplane.fetch_partition_bytes(
+                "localhost", server.port, str(tmp_path / "secret"), 1, 0
+            )
+        # and the same rule guards the shuffle fetch path
+        with pytest.raises(IoError, match="bad job id"):
+            dataplane.fetch_partition_bytes(
+                "localhost", server.port, "../secret", 1, 0, shuffle_output=0
+            )
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# int64 fidelity through IPC with nulls present
+# ---------------------------------------------------------------------------
+
+
+def test_ipc_nullable_int64_exact_roundtrip(tmp_path):
+    s = schema(("v", Int64))
+    big = (1 << 60) + 12345  # not representable in float64
+    vals = np.array([big, 7, big + 2, 0], dtype=np.int64)
+    validity = np.array([True, True, True, False])
+    cap = 8
+    pad = np.zeros(cap - 4, dtype=np.int64)
+    col = Column(
+        jnp.asarray(np.concatenate([vals, pad])), Int64,
+        jnp.asarray(np.concatenate([validity, np.zeros(cap - 4, bool)])),
+        None,
+    )
+    sel = np.zeros(cap, bool)
+    sel[:4] = True
+    batch = ColumnBatch(s, [col], jnp.asarray(sel), jnp.asarray(np.int32(4)))
+
+    path = str(tmp_path / "p" / "data.arrow")
+    ipc.write_partition(path, [batch])
+    names, arrays, nulls, dicts, kinds = ipc.read_partition_arrays(path)
+    assert names == ["v"]
+    got = arrays["v"]
+    assert got.dtype == np.int64, f"int64 degraded to {got.dtype}"
+    assert got[0] == big and got[2] == big + 2  # exact, no float rounding
+    assert list(nulls["v"]) == [False, False, False, True]
