@@ -31,6 +31,23 @@ _jax.config.update("jax_enable_x64", True)
 if _os.environ.get("JAX_PLATFORMS"):
     _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
 
+# Persistent XLA compilation cache: keyed by HLO hash, so identical operator
+# pipelines hit the disk cache across queries, operator instances, AND
+# processes (per-shape recompilation was the dominant first-run cost; see
+# benchmarks/RESULTS.md). Opt out with BALLISTA_XLA_CACHE="".
+_cache_dir = _os.environ.get(
+    "BALLISTA_XLA_CACHE",
+    _os.path.join(_os.path.expanduser("~"), ".cache", "ballista-tpu-xla"),
+)
+if _cache_dir:
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except (OSError, AttributeError):  # unwritable dir / older jax
+        pass
+
 BALLISTA_TPU_VERSION = "0.1.0"
 
 from .datatypes import (  # noqa: E402

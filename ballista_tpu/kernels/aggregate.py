@@ -7,9 +7,9 @@ physical operators follow the same two-phase decomposition).
 
 A CPU hash table is hostile to XLA, so grouping is *sort-based*:
 
-1. rows are ordered by chained stable argsorts, least-significant key first
-   (no bit-packing, so any number/width of key columns works); a final
-   stable sort on the live flag sinks dead rows to the end;
+1. rows are ordered by ONE multi-operand ``lax.sort`` (lexicographic over
+   [dead-flag, key columns..., row-index payload]; no bit-packing, so any
+   number/width of key columns works), sinking dead rows to the end;
 2. run-boundary detection (ANY key differs from the predecessor) + a prefix
    sum assigns dense group ids;
 3. ``segment_sum/min/max`` with ``indices_are_sorted=True`` reduces each
@@ -94,17 +94,23 @@ def grouped_aggregate(
             eff_keys.append(k)
 
     n = live.shape[0]
-    order = jnp.arange(n, dtype=jnp.int32)
-    for k in reversed(eff_keys):
-        order = order[jnp.argsort(k[order], stable=True)]
+    # ONE multi-operand lexicographic sort (dead flag first, then keys,
+    # then the row index as payload) replaces K chained stable argsorts +
+    # per-key gathers: a single lax.sort is both cheaper to trace and the
+    # form XLA lowers best on TPU. Sorted keys fall out as byproducts, so
+    # boundary detection needs no extra gathers either.
     dead = jnp.logical_not(live)
-    order = order[jnp.argsort(dead[order], stable=True)]
-    live_sorted = live[order]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(
+        (dead, *eff_keys, idx), num_keys=1 + len(eff_keys), is_stable=True
+    )
+    order = sorted_ops[-1]
+    sorted_keys = sorted_ops[1:-1]
+    live_sorted = jnp.logical_not(sorted_ops[0])
 
     # a row starts a new group if live and ANY key differs from predecessor
     first = None
-    for k in eff_keys:
-        ks = k[order]
+    for ks in sorted_keys:
         diff = jnp.concatenate([jnp.ones((1,), jnp.bool_), ks[1:] != ks[:-1]])
         first = diff if first is None else jnp.logical_or(first, diff)
     starts = jnp.logical_and(first, live_sorted)

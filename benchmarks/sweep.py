@@ -1,0 +1,50 @@
+"""First-run sweep: run all 22 TPC-H queries once in a fresh process and
+report per-query wall time (dominated by trace+compile on first touch).
+
+Usage: python -m benchmarks.sweep --path bench_data/sf02 [--queries 1,5,18]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--path", required=True)
+    ap.add_argument("--format", default="tbl")
+    ap.add_argument("--queries", default=",".join(str(i) for i in range(1, 23)))
+    args = ap.parse_args(argv)
+
+    from ballista_tpu.client import BallistaContext
+    from benchmarks.tpch.schema_def import register_tpch
+
+    t0 = time.time()
+    ctx = BallistaContext.standalone()
+    register_tpch(ctx, args.path, args.format, cached=True)
+    qdir = os.path.join(os.path.dirname(__file__), "tpch", "queries")
+
+    times = {}
+    for q in args.queries.split(","):
+        sql = open(os.path.join(qdir, f"q{q}.sql")).read()
+        t1 = time.time()
+        ctx.sql(sql).collect()
+        times[f"q{q}"] = round(time.time() - t1, 2)
+        print(f"q{q}: {times[f'q{q}']:.2f}s", flush=True)
+
+    worst = max(times, key=times.get)
+    print(json.dumps({
+        "total_s": round(time.time() - t0, 1),
+        "sum_query_s": round(sum(times.values()), 1),
+        "worst": worst,
+        "worst_s": times[worst],
+        "times": times,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
