@@ -25,23 +25,61 @@ import time
 REF_ROWS_PER_SEC = 6_001_215 / 1.9561  # reference q1 SF1 wall time
 
 
-def _tpu_available(timeout_s: float = 45.0) -> bool:
-    """Backend init can hang if the TPU tunnel is wedged. Probe in a
-    SUBPROCESS (an in-process probe thread would hold jax's backend-init
-    lock and deadlock the fallback path)."""
+def _probe_tpu(attempts: int = 3, timeout_s: float = 150.0,
+               retry_wait_s: float = 30.0) -> "tuple[bool, str]":
+    """Probe TPU availability; returns (ok, probe_log).
+
+    Backend init can hang if the TPU tunnel is wedged, so each attempt is
+    a SUBPROCESS with a timeout (an in-process probe thread would hold
+    jax's backend-init lock and deadlock the fallback path). The probe
+    runs a real tiny jit, not just ``jax.devices()`` — a listed device
+    whose compile path is dead would otherwise hang the benchmark proper.
+    Retries a few times over several minutes before giving up; the
+    returned log string records why it fell back."""
     import subprocess
 
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d=jax.devices(); "
-             "print('TPU_OK' if any('cpu' not in str(x).lower() for x in d)"
-             " else 'CPU_ONLY')"],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-        return "TPU_OK" in out.stdout
-    except Exception:  # noqa: BLE001 - timeout or crash -> no TPU
-        return False
+    code = (
+        "import time, jax\n"
+        "t0 = time.time()\n"
+        "d = jax.devices()\n"
+        "if all('cpu' in str(x).lower() for x in d):\n"
+        "    print('CPU_ONLY'); raise SystemExit(0)\n"
+        "import jax.numpy as jnp\n"
+        "(jnp.ones((256, 256)) @ jnp.ones((256, 256))).block_until_ready()\n"
+        "print(f'TPU_OK {d[0].platform} jit={time.time()-t0:.1f}s')\n"
+    )
+    log = []
+    for i in range(attempts):
+        t0 = time.time()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            if "TPU_OK" in out.stdout:
+                line = out.stdout.strip().splitlines()[-1]
+                log.append(f"attempt {i+1}: {line}")
+                return True, "; ".join(log)
+            if "CPU_ONLY" in out.stdout:
+                # deterministic: the device list won't change on retry
+                log.append(f"attempt {i+1}: no accelerator device listed")
+                return False, "; ".join(log)
+            else:
+                tail = (out.stderr or out.stdout).strip().splitlines()
+                log.append(
+                    f"attempt {i+1}: rc={out.returncode} "
+                    f"{tail[-1][:120] if tail else 'no output'}"
+                )
+        except subprocess.TimeoutExpired:
+            log.append(
+                f"attempt {i+1}: timeout at {time.time()-t0:.0f}s "
+                "(backend init or first compile hung — tunnel wedged?)"
+            )
+        except Exception as e:  # noqa: BLE001 - record and keep trying
+            log.append(f"attempt {i+1}: {type(e).__name__}: {e}")
+        if i < attempts - 1:
+            time.sleep(retry_wait_s)
+    return False, "; ".join(log) or f"probe skipped (attempts={attempts})"
 
 
 def main() -> None:
@@ -51,9 +89,18 @@ def main() -> None:
         os.path.dirname(os.path.abspath(__file__)), "bench_data"))
     ap.add_argument("--runs", type=int, default=3)
     ap.add_argument("--cpu", action="store_true", help="force CPU")
+    ap.add_argument("--probe-attempts", type=int,
+                    default=int(os.environ.get("BALLISTA_PROBE_ATTEMPTS", 3)))
+    ap.add_argument("--probe-timeout", type=float,
+                    default=float(os.environ.get("BALLISTA_PROBE_TIMEOUT", 150)))
     args = ap.parse_args()
 
-    force_cpu = args.cpu or not _tpu_available()
+    if args.cpu:
+        force_cpu, probe_log = True, "forced by --cpu"
+    else:
+        ok, probe_log = _probe_tpu(args.probe_attempts, args.probe_timeout)
+        force_cpu = not ok
+        print(f"# tpu probe: {probe_log}", file=sys.stderr)
     if force_cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -127,6 +174,7 @@ def main() -> None:
         "unit": "rows/s",
         "vs_baseline": round(value / REF_ROWS_PER_SEC, 3),
         "platform": platform,
+        "tpu_probe": probe_log,
         "scale": args.scale,
         "lineitem_rows": total_rows,
         "warm_seconds": round(warm, 4),
