@@ -171,8 +171,9 @@ class BallistaContext:
     def _collect(self, plan: LogicalPlan):
         if self.mode == "standalone":
             from .execution import collect
+            from .physical.planner import PlannerOptions
 
-            return collect(plan)
+            return collect(plan, PlannerOptions.from_settings(self.settings))
         from .distributed.client import remote_collect
 
         return remote_collect(self.host, self.port, plan, self.settings)
@@ -263,7 +264,11 @@ class DataFrame:
             from .execution import collect_physical, plan_logical
 
             if self._phys is None:
-                self._phys = plan_logical(self.plan)
+                from .physical.planner import PlannerOptions
+
+                self._phys = plan_logical(
+                    self.plan, PlannerOptions.from_settings(self.ctx.settings)
+                )
             return pd.DataFrame(collect_physical(self._phys))
         return self.ctx._collect(self.plan)
 

@@ -61,18 +61,22 @@ class SchedulerService:
                 "are registered in the client catalog)"
             )
         job_id = _job_id()
+        settings = dict(request.settings)
         self.state.save_job_status(job_id, JobStatus("queued"))
         t = threading.Thread(
-            target=self._plan_job, args=(job_id, plan), daemon=True,
+            target=self._plan_job, args=(job_id, plan, settings), daemon=True,
             name=f"plan-{job_id}",
         )
         t.start()
         return pb.ExecuteQueryResult(job_id=job_id)
 
-    def _plan_job(self, job_id: str, logical_plan):
+    def _plan_job(self, job_id: str, logical_plan, settings=None):
         try:
+            from ..physical.planner import PlannerOptions
+
             t0 = time.time()
-            phys = plan_logical(logical_plan)
+            phys = plan_logical(logical_plan,
+                                PlannerOptions.from_settings(settings))
             stages = DistributedPlanner().plan_query_stages(job_id, phys)
             for stage in stages:
                 deps = [

@@ -29,7 +29,7 @@ from .physical.base import PhysicalPlan
 from .physical.planner import create_physical_plan
 
 
-def resolve_scalar_subqueries(plan: LogicalPlan) -> LogicalPlan:
+def resolve_scalar_subqueries(plan: LogicalPlan, options=None) -> LogicalPlan:
     """Execute uncorrelated scalar subqueries and inline them as literals.
 
     Runs before optimization/serialization, so distributed plans never
@@ -44,7 +44,7 @@ def resolve_scalar_subqueries(plan: LogicalPlan) -> LogicalPlan:
                 "unplanned scalar subquery (correlated scalar subqueries "
                 "are only supported in WHERE comparisons)"
             )
-        out = collect_physical(plan_logical(sub))
+        out = collect_physical(plan_logical(sub, options))
         f = sub.schema().fields[0]
         col = out[f.name]
         if len(col) == 0:
@@ -99,9 +99,9 @@ def resolve_scalar_subqueries(plan: LogicalPlan) -> LogicalPlan:
 
 
 
-def plan_logical(plan: LogicalPlan) -> PhysicalPlan:
-    plan = resolve_scalar_subqueries(plan)
-    return create_physical_plan(optimize(plan))
+def plan_logical(plan: LogicalPlan, options=None) -> PhysicalPlan:
+    plan = resolve_scalar_subqueries(plan, options)
+    return create_physical_plan(optimize(plan), options)
 
 
 def collect_physical(phys: PhysicalPlan) -> Dict[str, np.ndarray]:
@@ -115,8 +115,8 @@ def collect_physical(phys: PhysicalPlan) -> Dict[str, np.ndarray]:
     return concat_pydicts(parts)
 
 
-def collect(plan: LogicalPlan):
+def collect(plan: LogicalPlan, options=None):
     """Logical plan -> pandas DataFrame (optimize, plan, execute, gather)."""
     import pandas as pd
 
-    return pd.DataFrame(collect_physical(plan_logical(plan)))
+    return pd.DataFrame(collect_physical(plan_logical(plan, options)))

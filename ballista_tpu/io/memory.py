@@ -17,6 +17,13 @@ class MemTableSource(TableSource):
         self._schema = schema
         self._partitions = partitions
 
+    def estimated_rows(self) -> Optional[int]:
+        total = 0
+        for part in self._partitions:
+            for b in part:
+                total += int(b.num_rows)
+        return total
+
     @staticmethod
     def from_pydict(schema: Schema, data: Dict, num_partitions: int = 1,
                     capacity: Optional[int] = None) -> "MemTableSource":

@@ -89,6 +89,11 @@ class ParquetSource(TableSource):
     def source_descriptor(self) -> dict:
         return {"kind": "parquet", "path": self._path}
 
+    def estimated_rows(self) -> Optional[int]:
+        import pyarrow.parquet as pq
+
+        return sum(pq.ParquetFile(f).metadata.num_rows for f in self._files)
+
     def _dictionary_for(self, colname: str) -> Dictionary:
         import pyarrow.parquet as pq
 

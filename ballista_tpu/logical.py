@@ -62,6 +62,10 @@ class TableSource:
         """Serializable description {kind, path, ...} for plan serde."""
         raise NotImplementedError
 
+    def estimated_rows(self) -> Optional[int]:
+        """Cheap row-count estimate (file sizes / metadata); None=unknown."""
+        return None
+
 
 @dataclass
 class TableScan(LogicalPlan):

@@ -53,6 +53,18 @@ class PhysicalPlan:
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
         raise NotImplementedError(type(self).__name__)
 
+    def estimated_rows(self) -> Optional[int]:
+        """Crude output-cardinality estimate for planning decisions (e.g.
+        picking a partitioned join when the build side is large). Filters
+        and joins deliberately over-estimate (pass-through / sum); None =
+        unknown."""
+        ests = [c.estimated_rows() for c in self.children()]
+        # any unknown child makes the total unknown: silently dropping it
+        # would UNDER-estimate, and callers rely on over-estimation
+        if not ests or any(e is None for e in ests):
+            return None
+        return sum(ests)
+
     def display(self) -> str:
         return type(self).__name__
 

@@ -43,6 +43,7 @@ class JoinExec(PhysicalPlan):
         on: List[Tuple[str, str]],  # (build_col, probe_col)
         how: str = "inner",
         null_aware: bool = False,
+        partitioned: bool = False,
     ):
         if how not in JOIN_TYPES:
             raise NotImplementedError_(f"join type {how}")
@@ -53,7 +54,14 @@ class JoinExec(PhysicalPlan):
         self.on = list(on)
         self.how = how
         self.null_aware = null_aware  # SQL NOT IN anti-join semantics
-        self._build_data = None  # (BuildTable, build_batch, unique, has_null)
+        # partitioned: both children are hash-partitioned on the join keys
+        # with the SAME partition count/hash (the planner wraps them in
+        # RepartitionExec), so partition p joins build[p] x probe[p] and
+        # the build side never merges across partitions. Beats the
+        # reference, which always passes join children through unsplit
+        # (reference: rust/scheduler/src/planner.rs:172-173).
+        self.partitioned = partitioned
+        self._build_data = {}  # partition -> (table, batch, unique, has_null)
         self._jit_probe = {}
 
     # -- composite keys ------------------------------------------------------
@@ -112,23 +120,37 @@ class JoinExec(PhysicalPlan):
 
     def with_new_children(self, children):
         return JoinExec(children[0], children[1], self.on, self.how,
-                        self.null_aware)
+                        self.null_aware, self.partitioned)
 
     def display(self) -> str:
         on = ", ".join(f"{l}={r}" for l, r in self.on)
-        return f"JoinExec: how={self.how} on=[{on}]"
+        part = " partitioned" if self.partitioned else ""
+        return f"JoinExec: how={self.how} on=[{on}]{part}"
 
     # -- execution ----------------------------------------------------------
 
-    def _materialize_build(self):
-        if self._build_data is not None:
-            return self._build_data
-        nparts = self.build.output_partitioning().num_partitions
-        batches = []
-        for p in range(nparts):
-            batches.extend(self.build.execute(p))
+    def _empty_build_batch(self) -> ColumnBatch:
+        """All-dead build batch for legitimately empty hash partitions."""
+        from ..columnar import empty_batch
+
+        return empty_batch(self.build.output_schema())
+
+    def _materialize_build(self, partition: int = 0):
+        key = partition if self.partitioned else 0
+        if key in self._build_data:
+            return self._build_data[key]
+        if self.partitioned:
+            batches = list(self.build.execute(partition))
+        else:
+            nparts = self.build.output_partitioning().num_partitions
+            batches = []
+            for p in range(nparts):
+                batches.extend(self.build.execute(p))
         if not batches:
-            raise ExecutionError("join build side produced no batches")
+            if self.partitioned:  # a hash partition may be empty
+                batches = [self._empty_build_batch()]
+            else:
+                raise ExecutionError("join build side produced no batches")
         bb = concat_batches(self.build.output_schema(), batches)
         bcols = [b for b, _ in self.on]
         self._check_key_ranges(bb, bcols)
@@ -144,11 +166,12 @@ class JoinExec(PhysicalPlan):
         sk = np.asarray(table.sorted_keys)
         nlive = int(table.num_live)
         unique = not bool(np.any(sk[1 : nlive] == sk[: nlive - 1])) if nlive > 1 else True
-        self._build_data = (table, bb, unique, has_null_key)
-        return self._build_data
+        self._build_data[key] = (table, bb, unique, has_null_key)
+        return self._build_data[key]
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
-        table, build_batch, unique, has_null_key = self._materialize_build()
+        table, build_batch, unique, has_null_key = \
+            self._materialize_build(partition)
         if self.how == "anti" and self.null_aware and has_null_key:
             # SQL NOT IN with a NULL in the subquery: predicate is never
             # true -> empty result

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..columnar import Column, ColumnBatch
+from ..columnar import Column, ColumnBatch, round_capacity
 from ..datatypes import Schema
 from ..errors import ExecutionError, NotImplementedError_
 from .. import expr as ex
@@ -74,6 +74,9 @@ class ScanExec(PhysicalPlan):
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
         yield from self.source.scan(partition, self.projection)
+
+    def estimated_rows(self):
+        return self.source.estimated_rows()
 
     def display(self) -> str:
         p = f" projection={list(self.projection)}" if self.projection else ""
@@ -312,17 +315,40 @@ class RepartitionExec(PhysicalPlan):
         return self._cache
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        """Yields COMPACTED batches: rows of the requested partition are
+        gathered to the front and the capacity shrinks to fit, so a
+        partitioned consumer (e.g. a co-partitioned join) does 1/N the
+        work per partition instead of re-touching full-capacity masked
+        batches. Mirrors the distributed path, where shuffle files are
+        mask-compacted on IPC write."""
         if self._jit_mask is None:
 
-            def mask_for(b: ColumnBatch, offset, p) -> ColumnBatch:
+            def mask_count(b: ColumnBatch, offset, p):
                 pids = self.partition_ids(b, offset)
                 sel = jnp.logical_and(b.selection, pids == p)
-                return b.with_selection(sel)
+                # stable sort sinks non-selected rows to the back
+                perm = jnp.argsort(jnp.logical_not(sel), stable=True)
+                return perm, jnp.sum(sel.astype(jnp.int32))
 
-            self._jit_mask = jax.jit(mask_for)
+            self._jit_mask = jax.jit(mask_count)
+        self._jit_take = getattr(self, "_jit_take", {})
         offset = 0
         for batch in self._materialize():
-            yield self._jit_mask(batch, jnp.int32(offset), jnp.int32(partition))
+            perm, count = self._jit_mask(batch, jnp.int32(offset),
+                                         jnp.int32(partition))
+            n = int(count)
+            # never exceed the source capacity: perm has batch.capacity
+            # entries, and a longer slice would silently clamp
+            cap = min(round_capacity(n), batch.capacity)
+            key = (batch.capacity, cap)
+            if key not in self._jit_take:
+
+                def take_front(b, perm, n, _cap=cap):
+                    live = jnp.arange(_cap, dtype=jnp.int32) < n
+                    return take_batch(b, perm[:_cap], live)
+
+                self._jit_take[key] = jax.jit(take_front)
+            yield self._jit_take[key](batch, perm, jnp.int32(n))
             offset += batch.num_rows_host()
 
     def display(self) -> str:

@@ -8,6 +8,9 @@ aggregate split and probe/build side selection for joins.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+from typing import Dict, Optional
+
 from ..errors import NotImplementedError_, PlanError
 from .. import expr as ex
 from ..logical import (
@@ -38,7 +41,44 @@ from .operators import (
 )
 
 
-def create_physical_plan(plan: LogicalPlan) -> PhysicalPlan:
+@dataclass
+class PlannerOptions:
+    """Physical planning knobs (client ``settings`` map them by key).
+
+    ``join_partition_threshold``: estimated build-side row count above
+    which both join inputs are hash-shuffled on the join keys and the join
+    runs co-partitioned (partition p joins build[p] x probe[p]) instead of
+    merging the whole build side to every task. None disables.
+    ``join_partitions``: partition count for such shuffled joins.
+    """
+
+    join_partition_threshold: Optional[int] = 4_000_000
+    join_partitions: int = 8
+
+    @staticmethod
+    def from_settings(settings: Optional[Dict[str, str]]) -> "PlannerOptions":
+        opts = PlannerOptions()
+        s = settings or {}
+        if "join.partitioned.threshold" in s:
+            v = s["join.partitioned.threshold"]
+            opts.join_partition_threshold = (
+                None if v in ("", "off", "none") else int(v)
+            )
+        if "join.partitions" in s:
+            opts.join_partitions = int(s["join.partitions"])
+        return opts
+
+
+def create_physical_plan(
+    plan: LogicalPlan, options: Optional[PlannerOptions] = None
+) -> PhysicalPlan:
+    return _create(plan, options or PlannerOptions())
+
+
+def _create(plan: LogicalPlan, opts: PlannerOptions) -> PhysicalPlan:
+    def create_physical_plan(p):  # threads opts through the recursion
+        return _create(p, opts)
+
     if isinstance(plan, TableScan):
         return ScanExec(plan.table_name, plan.source, plan.projection)
 
@@ -92,10 +132,33 @@ def create_physical_plan(plan: LogicalPlan) -> PhysicalPlan:
             on = [(r, l) for l, r in plan.on]
         else:
             raise NotImplementedError_(f"join type {plan.how}")
-        if build.output_partitioning().num_partitions > 1:
-            build = MergeExec(build)
-        joined: PhysicalPlan = JoinExec(build, probe, on, how,
-                                        null_aware=plan.null_aware)
+        threshold = opts.join_partition_threshold
+        est = build.estimated_rows()
+        # null-aware anti joins (NOT IN) must see the WHOLE build side:
+        # one NULL subquery value empties every partition's result, so a
+        # per-bucket build would miss nulls that hashed elsewhere
+        partitionable = not plan.null_aware
+        if (partitionable and threshold is not None and est is not None
+                and est > threshold):
+            # co-partitioned join: hash-shuffle BOTH sides on the join keys
+            # with the same partition count, so each task joins one bucket
+            # and no task ever holds the whole build side. (The reference
+            # passes join children through unsplit: planner.rs:172-173.)
+            n = opts.join_partitions
+            build = RepartitionExec(
+                build, n, [ex.ColumnRef(b) for b, _ in on]
+            )
+            probe = RepartitionExec(
+                probe, n, [ex.ColumnRef(p) for _, p in on]
+            )
+            joined: PhysicalPlan = JoinExec(build, probe, on, how,
+                                            null_aware=plan.null_aware,
+                                            partitioned=True)
+        else:
+            if build.output_partitioning().num_partitions > 1:
+                build = MergeExec(build)
+            joined = JoinExec(build, probe, on, how,
+                              null_aware=plan.null_aware)
         # restore logical column order if the physical (build-first) order
         # differs (e.g. preserved-left joins probe the left side)
         want = plan.schema().names()

@@ -385,6 +385,7 @@ def physical_to_proto(plan) -> pb.PhysicalPlanNode:
             o.right_col = r
         n.join.how = plan.how
         n.join.null_aware = plan.null_aware
+        n.join.partitioned = plan.partitioned
     elif isinstance(plan, ops.SortExec):
         n.sort.input.CopyFrom(physical_to_proto(plan.child))
         for e in plan.sort_exprs:
@@ -452,6 +453,7 @@ def physical_from_proto(n: pb.PhysicalPlanNode):
             [(o.left_col, o.right_col) for o in n.join.on],
             n.join.how,
             null_aware=n.join.null_aware,
+            partitioned=n.join.partitioned,
         )
     if kind == "sort":
         return ops.SortExec(
