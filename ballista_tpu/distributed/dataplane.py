@@ -154,6 +154,13 @@ class DataPlaneServer(socketserver.ThreadingTCPServer):
     def port(self) -> int:
         return self.server_address[1]
 
+    def close(self):
+        """Stop serving AND close the listening socket — shutdown() alone
+        leaves the OS accepting (and never answering) connections, so
+        peers hang until their recv timeout instead of getting refused."""
+        self.shutdown()
+        self.server_close()
+
 
 def start_data_plane(host: str, port: int, work_dir: str) -> DataPlaneServer:
     server = DataPlaneServer(host, port, work_dir)

@@ -85,7 +85,7 @@ class Executor:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
-        self._data_plane.shutdown()
+        self._data_plane.close()
         self._pool.shutdown(wait=False)
 
     # -- poll loop (reference: execution_loop.rs:31-76) ----------------------

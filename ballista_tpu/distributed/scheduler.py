@@ -120,12 +120,18 @@ class SchedulerService:
             num_devices=request.metadata.num_devices or 1,
         )
         self.state.save_executor_metadata(meta)
-        jobs_touched = set()
+        jobs_touched = set(self.state.reap_lost_tasks())
         for ts in request.task_status:
             st = _task_status_from_proto(ts)
             jobs_touched.add(st.partition.job_id)
             if st.state == "completed":
                 self.state.task_completed(st)
+            elif st.state == "failed" and self.state.recover_fetch_failure(st):
+                log.warning(
+                    "recovering job %s: lost shuffle data for task %s — "
+                    "re-queued producer partitions (%s)",
+                    st.partition.job_id, st.partition.key(), st.error,
+                )
             else:
                 self.state.save_task_status(st)
         result = pb.PollWorkResult()

@@ -211,12 +211,27 @@ class SchedulerState:
     def save_executor_metadata(self, meta: ExecutorMeta):
         self.kv.put(self._k("executors", meta.id), pickle.dumps(meta),
                     lease_secs=EXECUTOR_LEASE_SECS)
+        # durable (unleased) address record: shuffle locations must stay
+        # resolvable after a lease hiccup — liveness and addressing are
+        # separate concerns (the reference never lease-gates addresses,
+        # state/mod.rs:85-90)
+        self.kv.put(self._k("executors_meta", meta.id), pickle.dumps(meta))
 
     def get_executors_metadata(self) -> List[ExecutorMeta]:
+        # trailing '/' so the unleased executors_meta/ records don't match
         return [
             pickle.loads(v)
-            for _, v in self.kv.get_from_prefix(self._k("executors"))
+            for _, v in self.kv.get_from_prefix(self._k("executors") + "/")
         ]
+
+    def live_executor_ids(self) -> set:
+        """Executors with an unexpired lease."""
+        return {e.id for e in self.get_executors_metadata()}
+
+    def executor_address(self, executor_id: str) -> Optional[ExecutorMeta]:
+        """Last-known address, regardless of lease state."""
+        v = self.kv.get(self._k("executors_meta", executor_id))
+        return pickle.loads(v) if v is not None else None
 
     # -- jobs ---------------------------------------------------------------
 
@@ -289,9 +304,22 @@ class SchedulerState:
                     self._enqueue_stage(job_id, sid)
 
     def _enqueue_stage(self, job_id: str, stage_id: int):
+        """Enqueue the stage's PENDING tasks (state None) that are not
+        already queued — idempotent, so recovery can re-trigger it after
+        resetting lost tasks without double-running live ones."""
         n = self._stage_parts[(job_id, stage_id)]
+        started = {
+            t.partition.partition_id
+            for t in self.get_task_statuses(job_id, stage_id)
+            if t.state is not None
+        }
+        queued = {
+            p.partition_id for p in self._ready
+            if p.job_id == job_id and p.stage_id == stage_id
+        }
         for p in range(n):
-            self._ready.append(PartitionId(job_id, stage_id, p))
+            if p not in started and p not in queued:
+                self._ready.append(PartitionId(job_id, stage_id, p))
 
     def next_task(self) -> Optional[PartitionId]:
         with self._lock:
@@ -312,12 +340,13 @@ class SchedulerState:
             if n is None or len(done) < n:
                 return
             # stage complete: enqueue dependents whose deps are all complete
+            # (_enqueue_stage only picks up still-pending tasks, so this is
+            # safe to re-trigger after recovery resets)
             for (j, sid), deps in list(self._stage_deps.items()):
                 if j != job_id or stage_id not in deps:
                     continue
                 if all(self._stage_complete(j, d) for d in deps):
-                    if not self._stage_started(j, sid):
-                        self._enqueue_stage(j, sid)
+                    self._enqueue_stage(j, sid)
 
     def _stage_complete(self, job_id: str, stage_id: int) -> bool:
         n = self._stage_parts.get((job_id, stage_id), 0)
@@ -327,14 +356,6 @@ class SchedulerState:
         ]
         return len(done) >= n
 
-    def _stage_started(self, job_id: str, stage_id: int) -> bool:
-        return any(
-            t.state is not None
-            for t in self.get_task_statuses(job_id, stage_id)
-        ) or any(
-            p.job_id == job_id and p.stage_id == stage_id for p in self._ready
-        )
-
     def stage_locations(self, job_id: str) -> Dict[int, List[PartitionLocation]]:
         """Completed-task locations per stage (for shuffle resolution)."""
         out: Dict[int, List[PartitionLocation]] = {}
@@ -343,6 +364,12 @@ class SchedulerState:
             if t.state != "completed":
                 continue
             e = executors.get(t.executor_id)
+            if e is None and t.executor_id:
+                # lease expired: fall back to the durable address record —
+                # the data may still be served; if not, the consumer fails
+                # with a tagged ShuffleFetchError and recovery re-queues
+                # the producer (never hand out host="",port=0)
+                e = self.executor_address(t.executor_id)
             host, port = (e.host, e.port) if e else ("", 0)
             out.setdefault(t.partition.stage_id, []).append(
                 PartitionLocation(
@@ -357,6 +384,116 @@ class SchedulerState:
                 )
             )
         return out
+
+    # -- failure recovery ----------------------------------------------------
+    # The reference detects failures but never recovers (any failed task
+    # fails the job, state/mod.rs:342-346; lost shuffle data hangs or
+    # errors). We re-queue lost producer partitions on tagged fetch
+    # failures and re-queue running tasks of dead executors, with a
+    # per-job retry cap.
+
+    MAX_RECOVERIES_PER_JOB = 3
+
+    def _recovery_count(self, job_id: str) -> int:
+        v = self.kv.get(self._k("recoveries", job_id))
+        return int(v) if v else 0
+
+    def _bump_recovery(self, job_id: str) -> int:
+        n = self._recovery_count(job_id) + 1
+        self.kv.put(self._k("recoveries", job_id), str(n).encode())
+        return n
+
+    def _reset_task(self, pid: PartitionId):
+        self.save_task_status(TaskStatus(pid))
+
+    def recover_fetch_failure(self, st: TaskStatus) -> bool:
+        """Attempt recovery from a consumer task that failed with a tagged
+        ShuffleFetchError: reset the lost producer partitions and the
+        consumer task to pending and re-queue the producers. Returns True
+        if recovery was initiated (caller must NOT record the failure)."""
+        from ..errors import ShuffleFetchError
+
+        parsed = ShuffleFetchError.parse(st.error or "")
+        if parsed is None:
+            return False
+        job_id = st.partition.job_id
+        dep_stage, lost_parts, _executor = parsed
+        with self._lock:
+            known = self._stage_parts.get((job_id, dep_stage))
+            if known is None:
+                return False
+            # concurrent consumers failing on the SAME lost producer join
+            # the in-flight recovery instead of burning retry budget
+            statuses = {
+                t.partition.partition_id: t.state
+                for t in self.get_task_statuses(job_id, dep_stage)
+            }
+            fresh = [
+                p for p in lost_parts
+                if 0 <= p < known and statuses.get(p) == "completed"
+            ]
+            if fresh and self._bump_recovery(job_id) > \
+                    self.MAX_RECOVERIES_PER_JOB:
+                return False
+            for p in fresh:
+                self._reset_task(PartitionId(job_id, dep_stage, p))
+            self._reset_task(st.partition)
+            # queued tasks of stages depending on the now-incomplete
+            # producer would fail location resolution — pull them out;
+            # stage re-completion re-enqueues them
+            consumers = {
+                sid for (j, sid), deps in self._stage_deps.items()
+                if j == job_id and dep_stage in deps
+            }
+            self._ready = [
+                p for p in self._ready
+                if not (p.job_id == job_id and p.stage_id in consumers)
+            ]
+            self._enqueue_stage(job_id, dep_stage)
+        return True
+
+    def reap_lost_tasks(self, min_interval_secs: float = 5.0) -> List[str]:
+        """Re-queue running tasks whose executor's lease has expired (the
+        executor died mid-task; its completion report will never arrive).
+        One executor-death event costs ONE unit of the job's recovery
+        budget regardless of how many of its tasks were in flight.
+        Throttled; returns the job ids it touched so the caller can
+        re-synthesize their status (budget exhaustion marks tasks failed,
+        and nothing else would ever surface that to the client)."""
+        now = time.time()
+        with self._lock:
+            if now - getattr(self, "_last_reap", 0.0) < min_interval_secs:
+                return []
+            self._last_reap = now
+        live = self.live_executor_ids()
+        touched: List[str] = []
+        for k, v in self.kv.get_from_prefix(self._k("jobs")):
+            status = pickle.loads(v)
+            if status.state not in ("queued", "running"):
+                continue
+            job_id = k.rsplit("/", 1)[1]
+            with self._lock:
+                lost = [
+                    t for t in self.get_task_statuses(job_id)
+                    if t.state == "running" and t.executor_id
+                    and t.executor_id not in live
+                ]
+                if not lost:
+                    continue
+                touched.append(job_id)
+                if self._bump_recovery(job_id) > self.MAX_RECOVERIES_PER_JOB:
+                    for t in lost:
+                        self.save_task_status(TaskStatus(
+                            t.partition, "failed",
+                            error=f"executor {t.executor_id} lost and "
+                                  "retry budget exhausted",
+                        ))
+                    continue
+                for t in lost:
+                    self._reset_task(t.partition)
+                for sid in {t.partition.stage_id for t in lost}:
+                    self._enqueue_stage(job_id, sid)
+        return touched
 
     # -- job status synthesis (reference: state/mod.rs:267-358) --------------
 

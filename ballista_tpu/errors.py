@@ -42,3 +42,40 @@ class IoError(BallistaError):
 
 class ClusterError(BallistaError):
     """Scheduler/executor control-plane failure."""
+
+
+class ShuffleFetchError(IoError):
+    """A consumer could not fetch a producer stage's shuffle output
+    (producer executor dead or its data lost). Carries enough structure
+    in the message for the scheduler to re-queue the lost producer
+    partitions — the string format is the wire contract, since task
+    failures travel as plain error strings (TaskStatus.failed.error).
+    """
+
+    PREFIX = "SHUFFLE_FETCH_FAILED"
+
+    def __init__(self, stage_id: int, partition_ids, executor_id: str,
+                 cause: str):
+        self.stage_id = stage_id
+        self.partition_ids = sorted(set(partition_ids))
+        self.executor_id = executor_id
+        parts = ",".join(str(p) for p in self.partition_ids)
+        super().__init__(
+            f"{self.PREFIX} stage={stage_id} partitions={parts} "
+            f"executor={executor_id}: {cause}"
+        )
+
+    @classmethod
+    def parse(cls, message: str):
+        """Returns (stage_id, [partition_ids], executor_id) or None."""
+        if not message or not message.startswith(cls.PREFIX):
+            return None
+        try:
+            fields = dict(
+                kv.split("=", 1)
+                for kv in message[len(cls.PREFIX):].split(":", 1)[0].split()
+            )
+            parts = [int(p) for p in fields["partitions"].split(",") if p]
+            return int(fields["stage"]), parts, fields.get("executor", "")
+        except (KeyError, ValueError):
+            return None

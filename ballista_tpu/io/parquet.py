@@ -90,9 +90,14 @@ class ParquetSource(TableSource):
         return {"kind": "parquet", "path": self._path}
 
     def estimated_rows(self) -> Optional[int]:
-        import pyarrow.parquet as pq
+        est = getattr(self, "_est_rows", None)
+        if est is None:  # footer reads are real IO — compute once
+            import pyarrow.parquet as pq
 
-        return sum(pq.ParquetFile(f).metadata.num_rows for f in self._files)
+            est = sum(pq.ParquetFile(f).metadata.num_rows
+                      for f in self._files)
+            self._est_rows = est
+        return est
 
     def _dictionary_for(self, colname: str) -> Dictionary:
         import pyarrow.parquet as pq

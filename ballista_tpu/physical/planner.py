@@ -133,13 +133,12 @@ def _create(plan: LogicalPlan, opts: PlannerOptions) -> PhysicalPlan:
         else:
             raise NotImplementedError_(f"join type {plan.how}")
         threshold = opts.join_partition_threshold
-        est = build.estimated_rows()
         # null-aware anti joins (NOT IN) must see the WHOLE build side:
         # one NULL subquery value empties every partition's result, so a
         # per-bucket build would miss nulls that hashed elsewhere
-        partitionable = not plan.null_aware
-        if (partitionable and threshold is not None and est is not None
-                and est > threshold):
+        partitionable = not plan.null_aware and threshold is not None
+        est = build.estimated_rows() if partitionable else None
+        if partitionable and est is not None and est > threshold:
             # co-partitioned join: hash-shuffle BOTH sides on the join keys
             # with the same partition count, so each task joins one bucket
             # and no task ever holds the whole build side. (The reference

@@ -140,17 +140,46 @@ class ShuffleReaderExec(PhysicalPlan):
             if loc.path and os.path.exists(loc.path):
                 _, arrays, nulls, dicts, _ = ipc.read_partition_arrays(loc.path)
             else:
-                from ..distributed.dataplane import fetch_partition_bytes
-
-                buf = fetch_partition_bytes(
-                    loc.host, loc.port, loc.job_id, loc.stage_id,
-                    loc.partition_id, shuffle_output=loc.shuffle_output,
-                )
+                buf = self._fetch_with_retry(loc)
                 _, arrays, nulls, dicts, _ = ipc.read_partition_arrays(buf)
             parts.append((arrays, nulls, dicts))
         batches = ipc.batches_from_parts(self._schema, parts)
         self._cache[q] = batches
         return batches
+
+    def _fetch_with_retry(self, loc: PartitionLocation) -> bytes:
+        """One quick retry rides out transient hiccups; a persistent
+        failure (producer executor dead, data lost, or no known address)
+        raises a tagged ShuffleFetchError the scheduler can act on by
+        re-queueing the producer partition."""
+        import time as _time
+
+        from ..distributed.dataplane import fetch_partition_bytes
+        from ..errors import ShuffleFetchError
+
+        if not loc.host or not loc.port:
+            raise ShuffleFetchError(
+                loc.stage_id, [loc.partition_id], loc.executor_id,
+                "producer executor address unknown (lease expired?)",
+            )
+        last = None
+        for attempt in range(2):
+            try:
+                # 10s covers connect and each recv (not the whole
+                # transfer); a dead-but-backlogged peer fails fast
+                return fetch_partition_bytes(
+                    loc.host, loc.port, loc.job_id, loc.stage_id,
+                    loc.partition_id, shuffle_output=loc.shuffle_output,
+                    timeout=10.0,
+                )
+            except Exception as e:  # noqa: BLE001 - any transport failure
+                last = e
+                if attempt == 0:
+                    _time.sleep(1.0)
+        raise ShuffleFetchError(
+            loc.stage_id, [loc.partition_id], loc.executor_id,
+            f"{type(last).__name__}: {last}",
+        )
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
         yield from self._load_group(partition)
