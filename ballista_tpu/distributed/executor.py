@@ -40,7 +40,8 @@ class ExecutorConfig:
                  work_dir: Optional[str] = None, concurrent_tasks: int = 2,
                  scheduler_host: str = "localhost",
                  scheduler_port: int = 50050,
-                 bind_host: Optional[str] = None):
+                 bind_host: Optional[str] = None,
+                 num_devices: int = 1):
         # host = the address peers should dial (advertised in PollWork);
         # bind_host = the local interface the data plane listens on.
         # Distinct so NAT/port-forward setups can bind 0.0.0.0 while
@@ -48,6 +49,10 @@ class ExecutorConfig:
         self.host = host
         self.bind_host = bind_host if bind_host is not None else host
         self.port = port
+        # devices this executor owns (reported in PollWork metadata; the
+        # scheduler's mesh fusion relies on the operator setting
+        # mesh.devices consistently with the fleet)
+        self.num_devices = num_devices
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-")
         self.concurrent_tasks = concurrent_tasks
         self.scheduler_host = scheduler_host
@@ -106,7 +111,7 @@ class Executor:
         params.metadata.id = self.id
         params.metadata.host = self.config.host
         params.metadata.port = self.port
-        params.metadata.num_devices = 1
+        params.metadata.num_devices = self.config.num_devices
         with self._status_lock:
             for st in self._pending_status:
                 params.task_status.append(st)
@@ -236,7 +241,7 @@ class LocalCluster:
     """In-process scheduler + N executors (for tests and single-host use)."""
 
     def __init__(self, num_executors: int = 2, concurrent_tasks: int = 2,
-                 scheduler_port: int = 0):
+                 scheduler_port: int = 0, num_devices: int = 1):
         from .scheduler import serve_scheduler
         from .state import MemoryBackend, SchedulerState
 
@@ -249,6 +254,7 @@ class LocalCluster:
             cfg = ExecutorConfig(
                 scheduler_host="localhost", scheduler_port=self.port,
                 concurrent_tasks=concurrent_tasks,
+                num_devices=num_devices,
             )
             e = Executor(cfg)
             e.start()

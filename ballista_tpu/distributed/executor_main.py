@@ -35,6 +35,9 @@ def main(argv=None) -> int:
     ap.add_argument("--work-dir", default=env_default("work_dir", ""))
     ap.add_argument("--concurrent-tasks", type=int,
                     default=env_default("concurrent_tasks", 4))
+    ap.add_argument("--num-devices", type=int,
+                    default=env_default("num_devices", 0),
+                    help="devices this executor owns (0 = autodetect)")
     ap.add_argument("--local", action="store_true",
                     help="embed a standalone scheduler in-process")
     ap.add_argument("--log-level", default=env_default("log_level", "INFO"))
@@ -58,6 +61,11 @@ def main(argv=None) -> int:
         )
         print(f"embedded scheduler on localhost:{scheduler_port}", flush=True)
 
+    num_devices = args.num_devices
+    if not num_devices:
+        import jax
+
+        num_devices = len(jax.devices())
     cfg = ExecutorConfig(
         host=args.external_host or args.bind_host,
         bind_host=args.bind_host,
@@ -66,6 +74,7 @@ def main(argv=None) -> int:
         concurrent_tasks=args.concurrent_tasks,
         scheduler_host="localhost" if args.local else args.scheduler_host,
         scheduler_port=scheduler_port,
+        num_devices=num_devices,
     )
     executor = Executor(cfg)
     executor.start()

@@ -39,6 +39,84 @@ log = logging.getLogger("ballista.scheduler")
 SERVICE = "ballista_tpu.SchedulerGrpc"
 
 
+def _fuse_mesh_stages(stages, settings):
+    """ICI fast path: collapse a hash-shuffle stage + its final-aggregate
+    consumer into ONE MeshAggExec stage that runs the shuffle as an
+    in-SPMD ``lax.all_to_all`` over the executor's device mesh instead of
+    writing N^2 shuffle files through the data plane (the model being
+    replaced: location-resolved file fetches, reference
+    rust/scheduler/src/planner.rs:236-269 + shuffle_reader.rs:77-99).
+
+    Gated on the ``mesh.devices`` client setting (>= 2): fusion pins the
+    whole pair to one task, so the operator must know executors own that
+    many devices. Pattern matched exactly: consumer stage whose plan is
+    HashAggregateExec(final) over UnresolvedShuffleExec([S]) where S is a
+    hash-shuffle stage."""
+    from ..physical import operators as ops
+    from ..physical.aggregate import HashAggregateExec
+    from ..physical.mesh_agg import MeshAggExec
+    from ..physical.shuffle import QueryStageExec, UnresolvedShuffleExec
+
+    try:
+        n_mesh = int((settings or {}).get("mesh.devices", "0"))
+    except ValueError:
+        n_mesh = 0
+    if n_mesh < 2:
+        return stages
+    by_id = {s.stage_id: s for s in stages}
+    fused = []
+    dropped = set()
+    for stage in stages:
+        if stage.shuffle_output_partitions:
+            # this stage is itself a hash-shuffle producer (e.g. an
+            # aggregated subquery feeding a partitioned join); fusing it
+            # would drop its shuffle spec and break downstream readers
+            fused.append(stage)
+            continue
+        # walk through single-child vertical wrappers (output projection,
+        # HAVING filter) to the final aggregate
+        wrappers = []
+        plan = stage.child
+        while isinstance(plan, (ops.ProjectionExec, ops.FilterExec)):
+            wrappers.append(plan)
+            plan = plan.children()[0]
+        if not (isinstance(plan, HashAggregateExec) and plan.mode == "final"):
+            fused.append(stage)
+            continue
+        u = plan.child
+        if not (isinstance(u, UnresolvedShuffleExec)
+                and len(u.query_stage_ids) == 1):
+            fused.append(stage)
+            continue
+        producer = by_id.get(u.query_stage_ids[0])
+        if producer is None or not producer.shuffle_output_partitions \
+                or not producer.shuffle_hash_exprs:
+            fused.append(stage)
+            continue
+        dropped.add(producer.stage_id)
+        new_plan = MeshAggExec(
+            producer.child, plan.group_exprs, plan.agg_exprs,
+            list(producer.shuffle_hash_exprs), n_mesh, plan.group_capacity,
+        )
+        for w in reversed(wrappers):
+            new_plan = w.with_new_children([new_plan])
+        fused.append(QueryStageExec(stage.job_id, stage.stage_id, new_plan))
+        log.info("fused stages %d+%d into a %d-device mesh shuffle-agg",
+                 producer.stage_id, stage.stage_id, n_mesh)
+    return [s for s in fused if s.stage_id not in dropped]
+
+
+def _mesh_requirement(plan) -> int:
+    """Devices a task of this stage needs (max over MeshAggExec nodes;
+    0 = any executor). Drives device-aware task assignment."""
+    from ..physical.mesh_agg import MeshAggExec
+
+    need = plan.n_devices if isinstance(plan, MeshAggExec) else 0
+    for c in plan.children():
+        need = max(need, _mesh_requirement(c))
+    return need
+
+
 def _job_id() -> str:
     # 7-char alphanumeric starting with a letter (reference: lib.rs:262-270)
     first = random.choice(string.ascii_lowercase)
@@ -78,6 +156,7 @@ class SchedulerService:
             phys = plan_logical(logical_plan,
                                 PlannerOptions.from_settings(settings))
             stages = DistributedPlanner().plan_query_stages(job_id, phys)
+            stages = _fuse_mesh_stages(stages, settings)
             for stage in stages:
                 deps = [
                     sid
@@ -96,6 +175,7 @@ class SchedulerService:
                 self.state.save_stage_plan(
                     job_id, stage.stage_id, plan_bytes, nparts, deps,
                     shuffle_spec,
+                    mesh_devices=_mesh_requirement(stage.child),
                 )
                 for p in range(nparts):
                     self.state.save_task_status(
@@ -136,7 +216,7 @@ class SchedulerService:
                 self.state.save_task_status(st)
         result = pb.PollWorkResult()
         if request.can_accept_task:
-            task = self.state.next_task()
+            task = self.state.next_task(meta.num_devices)
             if task is not None:
                 try:
                     result.task.CopyFrom(self._task_definition(task, meta))
@@ -152,7 +232,7 @@ class SchedulerService:
 
     def _task_definition(self, task: PartitionId, meta: ExecutorMeta
                          ) -> pb.TaskDefinition:
-        plan_bytes, _, deps, shuffle_spec = self.state.get_stage_plan(
+        plan_bytes, _, deps, shuffle_spec, _mesh = self.state.get_stage_plan(
             task.job_id, task.stage_id
         )
         node = pb.PhysicalPlanNode()
@@ -162,7 +242,7 @@ class SchedulerService:
             locations = self.state.stage_locations(task.job_id)
             # expand hash-shuffled producer locations into per-consumer files
             for dep in deps:
-                _, _, _, dep_spec = self.state.get_stage_plan(task.job_id, dep)
+                _, _, _, dep_spec, _ = self.state.get_stage_plan(task.job_id, dep)
                 if dep_spec is not None and locations.get(dep):
                     # (missing/empty deps stay absent so shuffle resolution
                     # fails loudly with PlanError, not a zero-group reader)

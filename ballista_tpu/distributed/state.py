@@ -167,6 +167,8 @@ class SchedulerState:
         # stage dependency bookkeeping: (job, stage) -> [dep stage ids]
         self._stage_deps: Dict[Tuple[str, int], List[int]] = {}
         self._stage_parts: Dict[Tuple[str, int], int] = {}
+        # (job, stage) -> devices a task needs (0 = any)
+        self._stage_mesh: Dict[Tuple[str, int], int] = {}
         self._rehydrate()
 
     def _rehydrate(self):
@@ -184,10 +186,11 @@ class SchedulerState:
             for k, v in stage_rows:
                 job_id, sid = k[len(prefix):].split("/")
                 sid = int(sid)
-                row = pickle.loads(v)
+                row = (*pickle.loads(v), None, 0)[:5]
                 _, nparts, deps = row[:3]
                 self._stage_deps[(job_id, sid)] = list(deps)
                 self._stage_parts[(job_id, sid)] = nparts
+                self._stage_mesh[(job_id, sid)] = row[4] or 0
                 jobs.add(job_id)
             for job_id in jobs:
                 js = self.get_job_status(job_id)
@@ -246,26 +249,30 @@ class SchedulerState:
 
     def save_stage_plan(self, job_id: str, stage_id: int, plan_bytes: bytes,
                         num_partitions: int, dep_stage_ids: List[int],
-                        shuffle_spec: "tuple | None" = None):
+                        shuffle_spec: "tuple | None" = None,
+                        mesh_devices: int = 0):
         # shuffle_spec: (serialized hash expr bytes list | None, n_outputs)
+        # mesh_devices: devices a task of this stage needs (mesh-fused
+        # stages only; 0 = any executor can run it)
         self.kv.put(
             self._k("stages", job_id, stage_id),
             pickle.dumps(
-                (plan_bytes, num_partitions, dep_stage_ids, shuffle_spec)
+                (plan_bytes, num_partitions, dep_stage_ids, shuffle_spec,
+                 mesh_devices)
             ),
         )
         with self._lock:
             self._stage_deps[(job_id, stage_id)] = list(dep_stage_ids)
             self._stage_parts[(job_id, stage_id)] = num_partitions
+            self._stage_mesh[(job_id, stage_id)] = mesh_devices
 
     def get_stage_plan(self, job_id: str, stage_id: int):
         v = self.kv.get(self._k("stages", job_id, stage_id))
         if v is None:
             raise ClusterError(f"no stage plan {job_id}/{stage_id}")
         row = pickle.loads(v)
-        if len(row) == 3:  # older rows without a shuffle spec
-            row = (*row, None)
-        return row  # (plan_bytes, num_partitions, deps, shuffle_spec)
+        row = (*row, None, 0)[:5]  # pad older rows
+        return row  # (plan_bytes, num_partitions, deps, shuffle_spec, mesh)
 
     def stage_ids(self, job_id: str) -> List[int]:
         prefix = self._k("stages", job_id) + "/"
@@ -321,10 +328,16 @@ class SchedulerState:
             if p not in started and p not in queued:
                 self._ready.append(PartitionId(job_id, stage_id, p))
 
-    def next_task(self) -> Optional[PartitionId]:
+    def next_task(self, num_devices: int = 0) -> Optional[PartitionId]:
+        """Pop the first ready task the calling executor can run: a
+        mesh-fused stage's tasks only go to executors reporting at least
+        that many devices (0 = caller capacity unknown, accept any)."""
         with self._lock:
-            if self._ready:
-                return self._ready.pop(0)
+            for i, pid in enumerate(self._ready):
+                need = self._stage_mesh.get((pid.job_id, pid.stage_id), 0)
+                if need and num_devices and num_devices < need:
+                    continue
+                return self._ready.pop(i)
         return None
 
     def task_completed(self, st: TaskStatus):

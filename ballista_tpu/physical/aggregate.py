@@ -158,7 +158,12 @@ class HashAggregateExec(PhysicalPlan):
     def output_partitioning(self) -> Partitioning:
         if self.mode == "partial":
             return self.child.output_partitioning()
-        return Partitioning("unknown", 1)
+        # final mode: one output partition per input partition (1 after a
+        # merge; N when the partial states were hash-shuffled on the
+        # group keys, in which case groups are co-located per partition)
+        return Partitioning(
+            "unknown", self.child.output_partitioning().num_partitions
+        )
 
     def children(self):
         return [self.child]

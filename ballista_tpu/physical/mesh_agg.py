@@ -1,0 +1,211 @@
+"""Mesh-fused shuffle aggregation: the ICI fast path for a
+Repartition(hash) -> HashAggregate(final) stage pair.
+
+When one executor owns a whole device mesh, materializing N^2 shuffle
+files through the host data plane (reference model:
+rust/core/src/execution_plans/shuffle_reader.rs:77-99 — whole partitions
+over Arrow Flight) wastes the interconnect. This operator runs the pair
+as ONE SPMD XLA program instead:
+
+  per device: partial state rows -> hash destination ids
+           -> lax.all_to_all row exchange  (kernels.mesh_shuffle)
+           -> per-device final aggregation (groups are now co-located)
+
+The row->destination hash is ``compute_partition_ids`` — the same
+function the host shuffle uses — so the mesh path and the file path
+always agree on row placement (utf8 keys hash their string values via
+dictionary stable hashes, never producer-local codes).
+
+The scheduler's fusion pass (distributed/scheduler.py) builds this node
+from a shuffle stage + its final-aggregate consumer when the target
+executor reports enough devices; ``mesh.devices`` gates it.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..columnar import Column, ColumnBatch, round_capacity
+from ..datatypes import Schema
+from ..errors import ExecutionError
+from .. import expr as ex
+from ..kernels import mesh_shuffle
+from ..kernels.expr_eval import Evaluator
+from ..parallel.mesh import make_mesh
+from .aggregate import DEFAULT_GROUP_CAPACITY, HashAggregateExec
+from .base import PhysicalPlan, Partitioning, concat_batches
+
+
+class _SchemaOnly(PhysicalPlan):
+    """Placeholder child that only carries a schema (the mesh runner
+    feeds batches directly, there is nothing to execute)."""
+
+    def __init__(self, schema: Schema):
+        self._schema = schema
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def with_new_children(self, children):
+        return self
+
+
+class MeshAggExec(PhysicalPlan):
+    """One task that replaces a whole shuffle stage pair.
+
+    ``producer`` is the shuffle stage's child (scan -> ... -> partial
+    aggregate, P partitions, executed on host); its output rows are laid
+    out over an ``n_devices`` mesh and exchanged over ICI.
+    Output: a single partition containing every device's final groups.
+    """
+
+    def __init__(self, producer: PhysicalPlan, group_exprs: List[ex.Expr],
+                 agg_exprs: List[ex.Expr], hash_exprs: List[ex.Expr],
+                 n_devices: int,
+                 group_capacity: int = DEFAULT_GROUP_CAPACITY):
+        self.producer = producer
+        self.group_exprs = list(group_exprs)
+        self.agg_exprs = list(agg_exprs)
+        self.hash_exprs = list(hash_exprs)
+        self.n_devices = n_devices
+        self.group_capacity = group_capacity
+        self._partial_schema = producer.output_schema()
+        self._final = HashAggregateExec(
+            "final", self.group_exprs, self.agg_exprs,
+            _SchemaOnly(self._partial_schema), group_capacity,
+        )
+        self._ev = Evaluator(self._partial_schema)
+
+    # -- plan plumbing -------------------------------------------------------
+
+    def output_schema(self) -> Schema:
+        return self._final.output_schema()
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning("unknown", 1)
+
+    def children(self):
+        return [self.producer]
+
+    def with_new_children(self, children):
+        return MeshAggExec(children[0], self.group_exprs, self.agg_exprs,
+                           self.hash_exprs, self.n_devices,
+                           self.group_capacity)
+
+    def display(self) -> str:
+        g = ", ".join(e.name() for e in self.group_exprs)
+        return (f"MeshAggExec: {self.n_devices}-device ICI all_to_all "
+                f"shuffle + final agg gby=[{g}]")
+
+    # -- execution -----------------------------------------------------------
+
+    def _device_batches(self) -> List[ColumnBatch]:
+        """Run the producer on host and lay its live rows out round-robin
+        over the mesh slots (uniform capacity, materialized validity so
+        every slot shares one pytree structure)."""
+        batches = []
+        for p in range(self.producer.output_partitioning().num_partitions):
+            batches.extend(self.producer.execute(p))
+        if not batches:
+            from ..columnar import empty_batch
+
+            batches = [empty_batch(self._partial_schema)]
+        big = concat_batches(self._partial_schema, batches)  # unifies dicts
+        sel = np.asarray(big.selection)
+        rows = np.flatnonzero(sel)
+        chunks = np.array_split(rows, self.n_devices)
+        cap = round_capacity(max((len(c) for c in chunks), default=1) or 1)
+        out = []
+        for c in chunks:
+            cols = []
+            for col in big.columns:
+                vals = np.zeros((cap,), np.asarray(col.values).dtype)
+                vals[: len(c)] = np.asarray(col.values)[c]
+                if col.validity is not None:
+                    valid = np.zeros((cap,), bool)
+                    valid[: len(c)] = np.asarray(col.validity)[c]
+                else:
+                    valid = np.zeros((cap,), bool)
+                    valid[: len(c)] = True
+                cols.append(Column(jnp.asarray(vals), col.dtype,
+                                   jnp.asarray(valid), col.dictionary))
+            live = np.zeros((cap,), bool)
+            live[: len(c)] = True
+            out.append(ColumnBatch(
+                self._partial_schema, cols, jnp.asarray(live),
+                jnp.asarray(np.int32(len(c))),
+            ))
+        return out
+
+    def _spmd(self, stacked, mesh, cap: int, in_cap: int):
+        """(stacked batch pytree) -> (stacked out batch, num_groups[n])."""
+        from jax import shard_map
+        from functools import partial
+
+        n_dev = self.n_devices
+        fields = self._partial_schema.fields
+        final_fn = self._final._get_grouped_fn(cap, n_dev * in_cap)
+
+        @partial(shard_map, mesh=mesh, in_specs=(P("data"),),
+                 out_specs=(P("data"), P("data")), check_vma=False)
+        def run(stacked_b):
+            b = jax.tree.map(lambda x: x[0], stacked_b)
+            dest = _partition_ids(b, self.hash_exprs, n_dev, self._ev)
+            arrays = [c.values for c in b.columns] + \
+                     [c.validity for c in b.columns]
+            out_arrays, out_live, _counts = mesh_shuffle.all_to_all_rows(
+                arrays, b.selection, dest, "data", n_dev,
+                dest_capacity=in_cap,
+            )
+            vals = out_arrays[: len(fields)]
+            valids = out_arrays[len(fields):]
+            cols = [
+                Column(v, f.dtype, va, c.dictionary)
+                for v, va, f, c in zip(vals, valids, fields, b.columns)
+            ]
+            b2 = ColumnBatch(
+                self._partial_schema, cols, out_live,
+                jnp.sum(out_live).astype(jnp.int32),
+            )
+            out_batch, num_groups = final_fn(b2)
+            return (
+                jax.tree.map(lambda x: x[None], out_batch),
+                num_groups[None],
+            )
+
+        return run(stacked)
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        if partition != 0:
+            raise ExecutionError("MeshAggExec has a single output partition")
+        mesh = make_mesh(self.n_devices)
+        device_batches = self._device_batches()
+        in_cap = device_batches[0].capacity
+        stacked = jax.tree.map(
+            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+            *device_batches,
+        )
+        sharding = NamedSharding(mesh, P("data"))
+        stacked = jax.device_put(stacked, sharding)
+        cap = self.group_capacity
+        while True:
+            out_stacked, num_groups = self._spmd(stacked, mesh, cap, in_cap)
+            ng = int(np.max(np.asarray(num_groups)))
+            if ng <= cap:
+                break
+            cap = round_capacity(ng)  # overflow: recompile with exact cap
+        for q in range(self.n_devices):
+            yield jax.tree.map(lambda x, _q=q: jnp.asarray(x)[_q],
+                               out_stacked)
+
+
+def _partition_ids(batch: ColumnBatch, hash_exprs, n_dev: int,
+                   ev: Evaluator):
+    from .operators import compute_partition_ids
+
+    return compute_partition_ids(batch, hash_exprs, n_dev, 0, ev)

@@ -54,6 +54,11 @@ class PlannerOptions:
 
     join_partition_threshold: Optional[int] = 4_000_000
     join_partitions: int = 8
+    # hash-shuffled aggregation: partial -> Repartition(hash on group
+    # keys) -> final, instead of merging all partial tables to one task.
+    # None keeps the merge plan; N produces an N-partition final stage
+    # (the shape the mesh ICI fast path fuses — see distributed/scheduler)
+    agg_partitions: Optional[int] = None
 
     @staticmethod
     def from_settings(settings: Optional[Dict[str, str]]) -> "PlannerOptions":
@@ -66,6 +71,9 @@ class PlannerOptions:
             )
         if "join.partitions" in s:
             opts.join_partitions = int(s["join.partitions"])
+        if "agg.partitions" in s:
+            v = s["agg.partitions"]
+            opts.agg_partitions = None if v in ("", "off", "none") else int(v)
         return opts
 
 
@@ -91,6 +99,15 @@ def _create(plan: LogicalPlan, opts: PlannerOptions) -> PhysicalPlan:
     if isinstance(plan, Aggregate):
         child = create_physical_plan(plan.input)
         partial = HashAggregateExec("partial", plan.group_exprs, plan.agg_exprs, child)
+        if opts.agg_partitions and plan.group_exprs:
+            # shuffled aggregation: co-locate groups by hashing the
+            # materialized group columns, final-aggregate per partition
+            shuffled = RepartitionExec(
+                partial, opts.agg_partitions,
+                [ex.ColumnRef(e.name()) for e in plan.group_exprs],
+            )
+            return HashAggregateExec("final", plan.group_exprs,
+                                     plan.agg_exprs, shuffled)
         merged: PhysicalPlan = partial
         if partial.output_partitioning().num_partitions > 1:
             merged = MergeExec(partial)

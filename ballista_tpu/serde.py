@@ -351,6 +351,7 @@ def plan_from_proto(n: pb.LogicalPlanNode) -> lp.LogicalPlan:
 def physical_to_proto(plan) -> pb.PhysicalPlanNode:
     from .physical.aggregate import HashAggregateExec
     from .physical.join import JoinExec
+    from .physical.mesh_agg import MeshAggExec
     from .physical import operators as ops
     from .physical.shuffle import ShuffleReaderExec, UnresolvedShuffleExec
 
@@ -386,6 +387,16 @@ def physical_to_proto(plan) -> pb.PhysicalPlanNode:
         n.join.how = plan.how
         n.join.null_aware = plan.null_aware
         n.join.partitioned = plan.partitioned
+    elif isinstance(plan, MeshAggExec):
+        n.mesh_agg.producer.CopyFrom(physical_to_proto(plan.producer))
+        for e in plan.group_exprs:
+            n.mesh_agg.group_exprs.append(expr_to_proto(e))
+        for e in plan.agg_exprs:
+            n.mesh_agg.agg_exprs.append(expr_to_proto(e))
+        for e in plan.hash_exprs:
+            n.mesh_agg.hash_exprs.append(expr_to_proto(e))
+        n.mesh_agg.n_devices = plan.n_devices
+        n.mesh_agg.group_capacity = plan.group_capacity
     elif isinstance(plan, ops.SortExec):
         n.sort.input.CopyFrom(physical_to_proto(plan.child))
         for e in plan.sort_exprs:
@@ -454,6 +465,18 @@ def physical_from_proto(n: pb.PhysicalPlanNode):
             n.join.how,
             null_aware=n.join.null_aware,
             partitioned=n.join.partitioned,
+        )
+    if kind == "mesh_agg":
+        from .physical.aggregate import DEFAULT_GROUP_CAPACITY
+        from .physical.mesh_agg import MeshAggExec as _MeshAggExec
+
+        return _MeshAggExec(
+            physical_from_proto(n.mesh_agg.producer),
+            [expr_from_proto(e) for e in n.mesh_agg.group_exprs],
+            [expr_from_proto(e) for e in n.mesh_agg.agg_exprs],
+            [expr_from_proto(e) for e in n.mesh_agg.hash_exprs],
+            n.mesh_agg.n_devices,
+            n.mesh_agg.group_capacity or DEFAULT_GROUP_CAPACITY,
         )
     if kind == "sort":
         return ops.SortExec(

@@ -1,0 +1,152 @@
+"""ICI mesh shuffle integrated into the distributed runtime.
+
+A hash-shuffle SQL aggregation scheduled onto a mesh-owning executor must
+run as ONE fused SPMD program (lax.all_to_all row exchange + per-device
+final aggregation) with NO shuffle files written through the data plane —
+the BASELINE config-4 rehearsal ("q5 shuffle -> ICI all_to_all"). The
+host-file shuffle (reference model: shuffle_reader.rs:77-99) remains the
+cross-host path.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ballista_tpu import schema, Int64, Utf8, serde
+from ballista_tpu.client import BallistaContext
+from ballista_tpu.distributed.executor import LocalCluster
+from ballista_tpu.distributed.scheduler import _fuse_mesh_stages
+from ballista_tpu.distributed.planner import DistributedPlanner
+from ballista_tpu.io import MemTableSource
+from ballista_tpu.logical import LogicalPlanBuilder
+from ballista_tpu.physical.mesh_agg import MeshAggExec
+from ballista_tpu.physical.planner import PlannerOptions, create_physical_plan
+from ballista_tpu import col, sum_, count
+
+
+def _plan_shuffled_agg(src):
+    plan = (
+        LogicalPlanBuilder.scan("t", src)
+        .aggregate([col("k")], [sum_(col("v")).alias("sv"),
+                                count().alias("n")])
+        .build()
+    )
+    phys = create_physical_plan(plan, PlannerOptions(agg_partitions=4))
+    return DistributedPlanner().plan_query_stages("j1", phys)
+
+
+def _mem(tmp_path, n=500, mod=23, parts=3, name="t"):
+    from ballista_tpu.io import TblSource
+
+    s = schema(("k", Utf8), ("v", Int64))
+    rng = np.random.default_rng(7)
+    keys = [f"g{i}" for i in rng.integers(0, mod, n)]
+    vals = rng.integers(0, 100, n)
+    d = tmp_path / name
+    d.mkdir()
+    per = -(-n // parts)
+    for p in range(parts):
+        lines = [f"{keys[i]}|{vals[i]}|"
+                 for i in range(p * per, min((p + 1) * per, n))]
+        (d / f"part{p}.tbl").write_text("\n".join(lines) + "\n")
+    return TblSource(str(d), s), pd.DataFrame({"k": keys, "v": vals})
+
+
+def test_fusion_pattern_and_serde(eight_devices, tmp_path):
+    src, _ = _mem(tmp_path)
+    stages = _plan_shuffled_agg(src)
+    # unfused: a hash-shuffle producer stage + a final-agg consumer
+    assert any(s.shuffle_hash_exprs for s in stages)
+
+    fused = _fuse_mesh_stages(stages, {"mesh.devices": "8"})
+    assert len(fused) == len(stages) - 1
+    mesh_stage = fused[-1]
+    assert isinstance(mesh_stage.child, MeshAggExec)
+    assert mesh_stage.child.n_devices == 8
+    # the fused node round-trips through proto serde
+    rt = serde.physical_from_proto(serde.physical_to_proto(mesh_stage.child))
+    assert isinstance(rt, MeshAggExec) and rt.n_devices == 8
+    assert [e.name() for e in rt.hash_exprs] == ["k"]
+
+    # gate respected: no setting -> untouched
+    assert _fuse_mesh_stages(stages, {}) == stages
+
+
+def test_mesh_task_assignment_respects_num_devices():
+    """A mesh-fused task must not be handed to an executor with fewer
+    devices; plain tasks still flow to it."""
+    from ballista_tpu.distributed.state import MemoryBackend, SchedulerState
+    from ballista_tpu.distributed.types import PartitionId
+
+    state = SchedulerState(MemoryBackend())
+    state.save_stage_plan("j1", 1, b"", 1, [], mesh_devices=8)
+    state.save_stage_plan("j1", 2, b"", 1, [], mesh_devices=0)
+    state._ready = [PartitionId("j1", 1, 0), PartitionId("j1", 2, 0)]
+    # 1-device executor: skips the mesh task, gets the plain one
+    assert state.next_task(num_devices=1) == PartitionId("j1", 2, 0)
+    # 8-device executor: gets the mesh task
+    assert state.next_task(num_devices=8) == PartitionId("j1", 1, 0)
+    assert state.next_task(num_devices=8) is None
+
+
+def test_cluster_mesh_shuffle_agg(eight_devices, tmp_path):
+    src, df = _mem(tmp_path, n=800, mod=31)
+    cluster = LocalCluster(num_executors=1, concurrent_tasks=2,
+                          num_devices=8)
+    try:
+        ctx = BallistaContext.remote(
+            "localhost", cluster.port,
+            **{"agg.partitions": "8", "mesh.devices": "8"},
+        )
+        ctx.register_source("t", src)
+        got = ctx.sql(
+            "select k, sum(v) as sv, count(*) as n from t group by k order by k"
+        ).collect()
+
+        exp = df.groupby("k").agg(sv=("v", "sum"), n=("v", "size")) \
+            .reset_index().sort_values("k")
+        np.testing.assert_array_equal(got["k"], exp["k"])
+        np.testing.assert_array_equal(got["sv"].astype(np.int64),
+                                      exp["sv"].astype(np.int64))
+        np.testing.assert_array_equal(got["n"].astype(np.int64),
+                                      exp["n"].astype(np.int64))
+
+        # the mesh path must leave NO shuffle files behind: the exchange
+        # rode lax.all_to_all inside one SPMD program
+        shuffle_files = []
+        for e in cluster.executors:
+            for root, _, files in os.walk(e.config.work_dir):
+                shuffle_files += [f for f in files
+                                  if f.startswith("shuffle-")]
+        assert shuffle_files == [], f"host shuffle files written: {shuffle_files}"
+    finally:
+        cluster.shutdown()
+
+
+def test_cluster_file_shuffle_without_mesh_setting(eight_devices, tmp_path):
+    """Same query WITHOUT mesh.devices: the host-file shuffle runs (and
+    still matches), proving the fusion is what removed the files above."""
+    src, df = _mem(tmp_path, n=300, mod=11)
+    cluster = LocalCluster(num_executors=1, concurrent_tasks=2)
+    try:
+        ctx = BallistaContext.remote("localhost", cluster.port,
+                                     **{"agg.partitions": "4"})
+        ctx.register_source("t", src)
+        got = ctx.sql(
+            "select k, sum(v) as sv from t group by k order by k"
+        ).collect()
+        exp = df.groupby("k").agg(sv=("v", "sum")).reset_index() \
+            .sort_values("k")
+        np.testing.assert_array_equal(got["k"], exp["k"])
+        np.testing.assert_array_equal(got["sv"].astype(np.int64),
+                                      exp["sv"].astype(np.int64))
+        shuffle_files = []
+        for e in cluster.executors:
+            for root, _, files in os.walk(e.config.work_dir):
+                shuffle_files += [f for f in files
+                                  if f.startswith("shuffle-")]
+        assert shuffle_files, "expected host shuffle files on the file path"
+    finally:
+        cluster.shutdown()
