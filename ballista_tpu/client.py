@@ -148,6 +148,13 @@ class BallistaContext:
         cached = self._plan_cache.get(query)
         if cached is not None:
             return cached
+        if (self.mode == "remote"
+                and self.settings.get("plan.server") in ("on", "true", "1")
+                and not _is_ddl(query)):
+            # raw-SQL submission: the scheduler plans against the catalog
+            # shipped with the query (no client-side planning at collect
+            # time; DDL still registers in the client catalog below)
+            return DataFrame(self, None, raw_sql=query)
         stmt = parse_sql(query)
         if isinstance(stmt, CreateExternalTable):
             sch = make_schema(*[(n, t) for n, t in stmt.columns])
@@ -179,13 +186,21 @@ class BallistaContext:
         return remote_collect(self.host, self.port, plan, self.settings)
 
 
+def _is_ddl(query: str) -> bool:
+    return query.lstrip().lower().startswith("create")
+
+
 class DataFrame:
     """Lazy relational frame over a logical plan (reference:
     BallistaDataFrame, rust/client/src/context.rs:149-315)."""
 
-    def __init__(self, ctx: BallistaContext, plan: Optional[LogicalPlan]):
+    def __init__(self, ctx: BallistaContext, plan: Optional[LogicalPlan],
+                 raw_sql: Optional[str] = None):
         self.ctx = ctx
         self._plan = plan
+        # server-side planning: no local logical plan, the SQL text is
+        # submitted with the client catalog and planned by the scheduler
+        self._raw_sql = raw_sql
         # standalone mode caches the physical plan across collect() calls so
         # operator jit caches (and table caches) are reused
         self._phys = None
@@ -194,6 +209,12 @@ class DataFrame:
 
     @property
     def plan(self) -> LogicalPlan:
+        if self._plan is None and self._raw_sql is not None:
+            # server-planned frame used through the DataFrame API (schema,
+            # verbs, count...): plan locally on demand; collect() still
+            # takes the raw-SQL path
+            planner = SqlPlanner(self.ctx._catalog)
+            self._plan = planner.plan(parse_sql(self._raw_sql))
         if self._plan is None:
             raise PlanError("this DataFrame carries no plan (DDL result)")
         return self._plan
@@ -258,6 +279,13 @@ class DataFrame:
 
     def collect(self):
         """Execute and return a pandas DataFrame."""
+        if self._raw_sql is not None:
+            from .distributed.client import remote_sql_collect
+
+            return remote_sql_collect(
+                self.ctx.host, self.ctx.port, self._raw_sql,
+                self.ctx._catalog, self.ctx.settings,
+            )
         if self.ctx.mode == "standalone":
             import pandas as pd
 

@@ -32,6 +32,29 @@ def submit_plan(host: str, port: int, logical_plan,
         client.close()
 
 
+def submit_sql(host: str, port: int, sql: str, catalog,
+               settings: Optional[Dict[str, str]] = None) -> str:
+    """Raw-SQL submission: the scheduler plans server-side against the
+    catalog descriptors carried with the query (parity with the
+    reference's sql-or-plan ExecuteQuery, rust/scheduler/src/lib.rs:
+    236-247). ``catalog`` maps name -> sql.planner.CatalogTable."""
+    client = SchedulerClient(host, port)
+    try:
+        params = pb.ExecuteQueryParams()
+        params.sql = sql
+        for k, v in (settings or {}).items():
+            params.settings[k] = v
+        for name, ct in (catalog or {}).items():
+            entry = params.catalog.add()
+            entry.name = name
+            entry.source.CopyFrom(
+                serde.source_to_proto(ct.source, ct.primary_key)
+            )
+        return client.ExecuteQuery(params).job_id
+    finally:
+        client.close()
+
+
 def wait_for_job(host: str, port: int, job_id: str,
                  timeout: float = 300.0) -> pb.GetJobStatusResult:
     client = SchedulerClient(host, port)
@@ -57,25 +80,31 @@ def remote_collect(host: str, port: int, logical_plan,
                    settings: Optional[Dict[str, str]] = None,
                    timeout: float = 300.0):
     """Submit + poll + fetch -> pandas DataFrame."""
-    import numpy as np
-    import pandas as pd
-
-    from ..io import ipc
-    from ..columnar import concat_pydicts
-
     from ..execution import resolve_scalar_subqueries
 
     logical_plan = resolve_scalar_subqueries(logical_plan)
     job_id = submit_plan(host, port, logical_plan, settings)
     result = wait_for_job(host, port, job_id, timeout)
+    return _fetch_result_frames(result)
 
-    schema = None
-    parts = []
+
+def remote_sql_collect(host: str, port: int, sql: str, catalog,
+                       settings: Optional[Dict[str, str]] = None,
+                       timeout: float = 300.0):
+    """Raw-SQL round trip: submit SQL + catalog, poll, fetch."""
+    job_id = submit_sql(host, port, sql, catalog, settings)
+    result = wait_for_job(host, port, job_id, timeout)
+    return _fetch_result_frames(result)
+
+
+def _fetch_result_frames(result: pb.GetJobStatusResult):
+    import pandas as pd
+
+    from ..io import ipc
     locations = sorted(
         result.status.completed.partition_location,
         key=lambda l: l.partition_id.partition_id,
     )
-    out_schema = None
     frames = []
     for loc in locations:
         if loc.path and os.path.exists(loc.path):

@@ -131,28 +131,52 @@ class SchedulerService:
     # -- RPC: ExecuteQuery --------------------------------------------------
 
     def ExecuteQuery(self, request: pb.ExecuteQueryParams, context=None):
-        if request.WhichOneof("query") == "logical_plan":
-            plan = serde.plan_from_proto(request.logical_plan)
-        else:
-            raise ClusterError(
-                "raw SQL submission requires client-side planning (tables "
-                "are registered in the client catalog)"
-            )
         job_id = _job_id()
         settings = dict(request.settings)
+        if request.WhichOneof("query") == "logical_plan":
+            plan = serde.plan_from_proto(request.logical_plan)
+            args = (job_id, plan, settings, None, None)
+        else:
+            # raw SQL: planned server-side in the background thread (like
+            # plan failures, SQL errors land in JobStatus('failed') rather
+            # than an opaque transport error; reference accepts
+            # sql-or-plan, lib.rs:236-247)
+            args = (job_id, None, settings, request.sql,
+                    list(request.catalog))
         self.state.save_job_status(job_id, JobStatus("queued"))
         t = threading.Thread(
-            target=self._plan_job, args=(job_id, plan, settings), daemon=True,
+            target=self._plan_job, args=args, daemon=True,
             name=f"plan-{job_id}",
         )
         t.start()
         return pb.ExecuteQueryResult(job_id=job_id)
 
-    def _plan_job(self, job_id: str, logical_plan, settings=None):
+    def _plan_sql(self, sql: str, catalog_entries):
+        from ..sql.parser import CreateExternalTable, parse_sql
+        from ..sql.planner import CatalogTable, SqlPlanner
+
+        catalog = {}
+        for ct in catalog_entries:
+            src = serde.source_from_proto(ct.source)
+            catalog[ct.name] = CatalogTable(
+                ct.name, src, ct.source.primary_key or None
+            )
+        stmt = parse_sql(sql)
+        if isinstance(stmt, CreateExternalTable):
+            raise ClusterError(
+                "CREATE EXTERNAL TABLE is a client-side statement; the "
+                "scheduler keeps no durable catalog"
+            )
+        return SqlPlanner(catalog).plan(stmt)
+
+    def _plan_job(self, job_id: str, logical_plan, settings=None,
+                  sql=None, catalog_entries=None):
         try:
             from ..physical.planner import PlannerOptions
 
             t0 = time.time()
+            if logical_plan is None:
+                logical_plan = self._plan_sql(sql, catalog_entries or [])
             phys = plan_logical(logical_plan,
                                 PlannerOptions.from_settings(settings))
             stages = DistributedPlanner().plan_query_stages(job_id, phys)
