@@ -47,8 +47,8 @@ class JoinExec(PhysicalPlan):
     ):
         if how not in JOIN_TYPES:
             raise NotImplementedError_(f"join type {how}")
-        if not 1 <= len(on) <= 2:
-            raise NotImplementedError_("joins support 1-2 key columns")
+        if not on:
+            raise NotImplementedError_("joins require at least one key")
         self.build = build
         self.probe = probe
         self.on = list(on)
@@ -63,13 +63,25 @@ class JoinExec(PhysicalPlan):
         self.partitioned = partitioned
         self._build_data = {}  # partition -> (table, batch, unique, has_null)
         self._jit_probe = {}
+        self._jit_codec_build = {}
+        self._remap_cache = {}
 
     # -- composite keys ------------------------------------------------------
+    #
+    # Three representations, picked at build materialization:
+    #   "raw"    1 key column: its int64 values, exact.
+    #   "packed" 2 key columns within 31/32-bit ranges: (a << 32) | b.
+    #   "codec"  anything else: each key column is iteratively RANKED
+    #            against the (sorted) build side and packed with the
+    #            running code, which is re-ranked back under the build
+    #            capacity — exact for any number/width of key columns
+    #            (no hash collisions), static shapes, ~2 sorts per extra
+    #            column. Probe rows ride the same tables; a probe value
+    #            absent from the build fails its exactness check and can
+    #            never collide into a live build code.
 
     def _key_of(self, batch: ColumnBatch, cols: List[str]):
-        """(int64 key, live-mask-extension). Two-column keys pack as
-        (a << 32) | b — exact for the 31/32-bit key ranges checked in
-        _check_key_ranges."""
+        """raw/packed representations (codec handled separately)."""
         first = batch.column(cols[0])
         keys = first.values.astype(jnp.int64)
         live_ext = first.validity
@@ -84,21 +96,78 @@ class JoinExec(PhysicalPlan):
                 )
         return keys, live_ext
 
-    def _check_key_ranges(self, batch: ColumnBatch, cols: List[str]):
-        if len(cols) != 2:
-            return
-        import numpy as np
-
+    def _packable(self, batch: ColumnBatch, cols: List[str]) -> bool:
+        """True when 2-column keys fit the 31/32-bit packing (host check
+        on the build side; out-of-range keys fall back to the codec)."""
+        if len(cols) == 1:
+            return True  # raw values, always exact
+        if len(cols) > 2:
+            return False  # codec handles any column count
         a = np.asarray(batch.column(cols[0]).values)
         b = np.asarray(batch.column(cols[1]).values)
         sel = np.asarray(batch.selection)
-        if sel.any():
-            if (np.abs(a[sel]) >= (1 << 31)).any() or (b[sel] < 0).any() \
-                    or (b[sel] >= (1 << 32) - 1).any():
-                raise ExecutionError(
-                    f"composite join keys {cols} exceed the packable 31/32-bit "
-                    "range"
-                )
+        if not sel.any():
+            return True
+        return not (
+            (np.abs(a[sel]) >= (1 << 31)).any() or (b[sel] < 0).any()
+            or (b[sel] >= (1 << 32) - 1).any()
+        )
+
+    def _key_live_ext(self, batch: ColumnBatch, cols: List[str]):
+        live_ext = None
+        for c in cols:
+            v = batch.column(c).validity
+            if v is not None:
+                live_ext = v if live_ext is None else jnp.logical_and(
+                    live_ext, v)
+        return live_ext
+
+    def _codec_build(self, bb: ColumnBatch, cols: List[str]):
+        """(codes, live, tables) for the build side. Traced."""
+        live_ext = self._key_live_ext(bb, cols)
+        live = bb.selection
+        if live_ext is not None:
+            live = jnp.logical_and(live, live_ext)
+        nlive = jnp.sum(live.astype(jnp.int32))
+        cap = bb.capacity
+        maxi = jnp.iinfo(jnp.int64).max
+        tables = []
+        code = None
+        for c in cols:
+            v = bb.column(c).values.astype(jnp.int64)
+            sv = jnp.sort(jnp.where(live, v, maxi))
+            r = jnp.searchsorted(sv, v).astype(jnp.int64)
+            if code is None:
+                code = r
+                tables.append((sv, None))
+            else:
+                combined = code * (cap + 1) + r
+                sc = jnp.sort(jnp.where(live, combined, maxi))
+                code = jnp.searchsorted(sc, combined).astype(jnp.int64)
+                tables.append((sv, sc))
+        return code, live, (tuple(tables), nlive)
+
+    def _codec_probe(self, vals, tables, nlive):
+        """(codes, exact mask) for probe key value arrays using the
+        build's rank tables. Traced."""
+        exact = jnp.ones(vals[0].shape, jnp.bool_)
+        cap = tables[0][0].shape[0]
+        code = None
+        for v, (sv, sc) in zip(vals, tables):
+            r = jnp.searchsorted(sv, v).astype(jnp.int64)
+            hit = jnp.take(sv, jnp.minimum(r, cap - 1)) == v
+            exact = jnp.logical_and(exact,
+                                    jnp.logical_and(r < nlive, hit))
+            if code is None:
+                code = r
+            else:
+                combined = code * (cap + 1) + r
+                rc = jnp.searchsorted(sc, combined).astype(jnp.int64)
+                hitc = jnp.take(sc, jnp.minimum(rc, cap - 1)) == combined
+                exact = jnp.logical_and(exact,
+                                        jnp.logical_and(rc < nlive, hitc))
+                code = rc
+        return code, exact
 
     # -- schema -------------------------------------------------------------
 
@@ -153,24 +222,36 @@ class JoinExec(PhysicalPlan):
                 raise ExecutionError("join build side produced no batches")
         bb = concat_batches(self.build.output_schema(), batches)
         bcols = [b for b, _ in self.on]
-        self._check_key_ranges(bb, bcols)
-        keys, live_ext = self._key_of(bb, bcols)
-        live = bb.selection
+        live_ext = self._key_live_ext(bb, bcols)
         has_null_key = False
         if live_ext is not None:
             has_null_key = bool(
                 np.any(np.asarray(bb.selection) & ~np.asarray(live_ext))
             )
-            live = jnp.logical_and(live, live_ext)
+        if self._packable(bb, bcols):
+            mode = "raw" if len(bcols) == 1 else "packed"
+            keys, _ = self._key_of(bb, bcols)
+            live = bb.selection
+            if live_ext is not None:
+                live = jnp.logical_and(live, live_ext)
+            key_tables = ()
+        else:
+            mode = "codec"
+            if bb.capacity not in self._jit_codec_build:
+                self._jit_codec_build[bb.capacity] = jax.jit(
+                    lambda b: self._codec_build(b, bcols)
+                )
+            keys, live, key_tables = self._jit_codec_build[bb.capacity](bb)
         table = jax.jit(join_k.build_lookup)(keys, live)
         sk = np.asarray(table.sorted_keys)
         nlive = int(table.num_live)
         unique = not bool(np.any(sk[1 : nlive] == sk[: nlive - 1])) if nlive > 1 else True
-        self._build_data[key] = (table, bb, unique, has_null_key)
+        self._build_data[key] = (table, bb, unique, has_null_key, mode,
+                                 key_tables)
         return self._build_data[key]
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
-        table, build_batch, unique, has_null_key = \
+        table, build_batch, unique, has_null_key, mode, key_tables = \
             self._materialize_build(partition)
         if self.how == "anti" and self.null_aware and has_null_key:
             # SQL NOT IN with a NULL in the subquery: predicate is never
@@ -180,55 +261,142 @@ class JoinExec(PhysicalPlan):
                     jnp.zeros((pb.capacity,), jnp.bool_)
                 )
             return
-        pcols = [p for _, p in self.on]
         for pb in self.probe.execute(partition):
-            self._check_key_ranges(pb, pcols)
+            remaps = self._remaps_for(build_batch, pb)
             if unique:
-                yield self._probe_unique_batch(table, build_batch, pb)
+                yield self._probe_unique_batch(table, build_batch, pb,
+                                               mode, key_tables, remaps)
             else:
-                yield from self._probe_expand_batch(table, build_batch, pb)
+                yield from self._probe_expand_batch(table, build_batch, pb,
+                                                    mode, key_tables, remaps)
 
     # fast path: unique build keys ------------------------------------------
 
-    def _probe_keys(self, pb: ColumnBatch):
-        pkeys, live_ext = self._key_of(pb, [p for _, p in self.on])
-        plive = pb.selection
-        if live_ext is not None:
-            plive = jnp.logical_and(plive, live_ext)
-        return pkeys, plive
+    def _probe_col_values(self, pb: ColumnBatch, pcol: str, remap):
+        """Probe key column as int64 values + validity; utf8 codes are
+        remapped into the BUILD dictionary's code space (codes are
+        producer-local; comparing them across tables would be wrong).
+        Probe strings absent from the build dictionary map to -1 ->
+        invalid (they cannot match anything)."""
+        c = pb.column(pcol)
+        v = c.values.astype(jnp.int64)
+        valid = c.validity
+        if remap is not None:
+            idx = jnp.clip(v, 0, remap.shape[0] - 1).astype(jnp.int32)
+            v2 = jnp.take(remap, idx)
+            miss = v2 < 0
+            valid = (
+                jnp.logical_not(miss) if valid is None
+                else jnp.logical_and(valid, jnp.logical_not(miss))
+            )
+            v = jnp.where(miss, jnp.int64(0), v2)
+        return v, valid
 
-    def _probe_unique_batch(self, table, build_batch, pb: ColumnBatch) -> ColumnBatch:
-        key = ("u", pb.capacity, build_batch.capacity)
+    def _probe_keys(self, pb: ColumnBatch, mode: str, key_tables, remaps):
+        # mode is static (baked into the jit cache key); key_tables and
+        # remaps are traced arguments so per-partition builds / per-source
+        # dictionaries don't leak into the cached traces as constants
+        pcols = [p for _, p in self.on]
+        vals = []
+        valid_all = None
+        for pcol, remap in zip(pcols, remaps):
+            v, valid = self._probe_col_values(pb, pcol, remap)
+            vals.append(v)
+            if valid is not None:
+                valid_all = (
+                    valid if valid_all is None
+                    else jnp.logical_and(valid_all, valid)
+                )
+        plive = pb.selection
+        if valid_all is not None:
+            plive = jnp.logical_and(plive, valid_all)
+        if mode == "codec":
+            tables, nlive = key_tables
+            pkeys, exact = self._codec_probe(vals, tables, nlive)
+            return pkeys, jnp.logical_and(plive, exact)
+        if mode == "raw":
+            return vals[0], plive
+        # packed: probe keys outside the packable range cannot equal any
+        # (in-range) build key — mask them out instead of aliasing
+        a, b = vals
+        in_range = jnp.logical_and(
+            jnp.abs(a) < (jnp.int64(1) << 31),
+            jnp.logical_and(b >= 0, b < (jnp.int64(1) << 32) - 1),
+        )
+        keys = (a << 32) | (b & jnp.int64(0xFFFFFFFF))
+        return keys, jnp.logical_and(plive, in_range)
+
+    def _remaps_for(self, build_batch: ColumnBatch, pb: ColumnBatch):
+        """Per key column: probe-code -> build-code remap array (or None
+        when no dictionary translation is needed). Host-computed once per
+        (key column, probe dictionary), exact via sorted-dict search."""
+        out = []
+        for bcol, pcol in self.on:
+            bd = build_batch.column(bcol).dictionary
+            pd_ = pb.column(pcol).dictionary
+            if bd is None and pd_ is None:
+                out.append(None)
+                continue
+            if bd is None or pd_ is None:
+                raise ExecutionError(
+                    f"join key {bcol}={pcol} mixes utf8 and non-utf8 columns"
+                )
+            if bd is pd_:
+                out.append(None)  # shared dictionary: codes comparable
+                continue
+            ck = (bcol, id(pd_))
+            if ck not in self._remap_cache:
+                bvals = bd.values.astype(str)
+                pvals = pd_.values.astype(str)
+                if len(bvals):
+                    idx = np.searchsorted(bvals, pvals)
+                    idx_c = np.minimum(idx, len(bvals) - 1)
+                    ok = bvals[idx_c] == pvals
+                    remap = np.where(ok, idx_c, -1).astype(np.int64)
+                else:
+                    remap = np.full(max(len(pvals), 1), -1, np.int64)
+                self._remap_cache[ck] = jnp.asarray(remap)
+            out.append(self._remap_cache[ck])
+        return tuple(out)
+
+    def _probe_unique_batch(self, table, build_batch, pb: ColumnBatch,
+                            mode: str, key_tables, remaps) -> ColumnBatch:
+        key = ("u", mode, pb.capacity, build_batch.capacity)
         if key not in self._jit_probe:
 
-            def run(table, bb: ColumnBatch, pb: ColumnBatch) -> ColumnBatch:
-                pkeys, plive = self._probe_keys(pb)
+            def run(table, bb: ColumnBatch, pb: ColumnBatch,
+                    key_tables, remaps) -> ColumnBatch:
+                pkeys, plive = self._probe_keys(pb, mode, key_tables, remaps)
                 build_rows, matched = join_k.probe_unique(table, pkeys, plive)
                 return self._assemble(bb, pb, build_rows, matched,
                                       pb.selection, None)
 
             self._jit_probe[key] = jax.jit(run)
-        return self._jit_probe[key](table, build_batch, pb)
+        return self._jit_probe[key](table, build_batch, pb, key_tables,
+                                    remaps)
 
     # general path: expanding probe -----------------------------------------
 
-    def _probe_expand_batch(self, table, build_batch,
-                            pb: ColumnBatch) -> Iterator[ColumnBatch]:
+    def _probe_expand_batch(self, table, build_batch, pb: ColumnBatch,
+                            mode: str, key_tables,
+                            remaps) -> Iterator[ColumnBatch]:
         if self.how not in ("inner", "left", "semi", "anti"):
             raise NotImplementedError_(
                 f"{self.how} join with duplicate build keys"
             )
         if self.how in ("semi", "anti"):
             # membership only: unique probe works regardless of build dups
-            yield self._probe_unique_batch(table, build_batch, pb)
+            yield self._probe_unique_batch(table, build_batch, pb,
+                                           mode, key_tables, remaps)
             return
         out_cap = pb.capacity
         while True:
-            key = ("e", pb.capacity, build_batch.capacity, out_cap)
+            key = ("e", mode, pb.capacity, build_batch.capacity, out_cap)
             if key not in self._jit_probe:
 
-                def run(table, bb, pb, _cap=out_cap):
-                    pkeys, plive = self._probe_keys(pb)
+                def run(table, bb, pb, key_tables, remaps, _cap=out_cap):
+                    pkeys, plive = self._probe_keys(pb, mode, key_tables,
+                                                    remaps)
                     prows, brows, olive, total = join_k.probe_expand(
                         table, pkeys, plive, _cap
                     )
@@ -236,7 +404,8 @@ class JoinExec(PhysicalPlan):
                     return out, total
 
                 self._jit_probe[key] = jax.jit(run)
-            out, total = self._jit_probe[key](table, build_batch, pb)
+            out, total = self._jit_probe[key](table, build_batch, pb,
+                                              key_tables, remaps)
             t = int(total)
             if t <= out_cap:
                 break
@@ -244,11 +413,12 @@ class JoinExec(PhysicalPlan):
         yield out
         if self.how == "left":
             # preserved probe rows with no match, null build columns
-            key = ("l", pb.capacity, build_batch.capacity)
+            key = ("l", mode, pb.capacity, build_batch.capacity)
             if key not in self._jit_probe:
 
-                def run_unmatched(table, bb, pb):
-                    pkeys, plive = self._probe_keys(pb)
+                def run_unmatched(table, bb, pb, key_tables, remaps):
+                    pkeys, plive = self._probe_keys(pb, mode, key_tables,
+                                                    remaps)
                     counts = join_k.probe_counts(table, pkeys)
                     unmatched = jnp.logical_and(pb.selection,
                                                 jnp.logical_or(
@@ -260,7 +430,8 @@ class JoinExec(PhysicalPlan):
                                           None)
 
                 self._jit_probe[key] = jax.jit(run_unmatched)
-            yield self._jit_probe[key](table, build_batch, pb)
+            yield self._jit_probe[key](table, build_batch, pb, key_tables,
+                                       remaps)
 
     # assembly --------------------------------------------------------------
 

@@ -589,9 +589,12 @@ class SqlPlanner:
         while pending:
             progress = False
             for alias in list(pending):
-                # collect ALL edges connecting alias to the joined set: up
-                # to two become composite join keys (e.g. partsupp's
-                # (partkey, suppkey)); extras fall back to post filters
+                # collect ALL edges connecting alias to the joined set;
+                # every equality edge becomes a composite join key (the
+                # join kernels rank arbitrary key tuples against the
+                # build side, so there is no column-count cap — and outer
+                # joins MUST put every condition in the ON clause, a
+                # post filter would drop preserved rows)
                 mine: List[Tuple[Tuple[str, str], tuple]] = []
                 for e_ in edges:
                     a1, c1, a2, c2 = e_
@@ -601,21 +604,20 @@ class SqlPlanner:
                         mine.append(((c2, c1), e_))
                 if not mine:
                     continue
-                key_pairs = [p for p, _ in mine[:2]]  # (t_col, acc_col)
-                extra = mine[2:]
+                key_pairs = [p for p, _ in mine]  # (t_col, acc_col)
                 t_alias = alias
                 rel = by_alias[t_alias]
                 t_plan = filtered_plan(rel)
                 how = explicit_how.get(t_alias, "inner")
                 t_col = key_pairs[0][0]
                 acc_col = key_pairs[0][1]
-                if len(key_pairs) == 2 and how == "inner":
+                if len(key_pairs) >= 2 and how == "inner":
                     # composite join: build the new table (runtime
                     # uniqueness detection picks the fast path when the
                     # composite key is unique, e.g. partsupp)
                     on = [(t, a) for t, a in key_pairs]
                     plan = Join(t_plan, plan, on, how)
-                elif len(key_pairs) == 2:
+                elif len(key_pairs) >= 2:
                     # outer joins preserve the accumulated side
                     on = [(a, t) for t, a in key_pairs]
                     plan = Join(plan, t_plan, on, how)
@@ -635,12 +637,7 @@ class SqlPlanner:
                     plan = Join(t_plan, plan, [(t_col, acc_col)], how)
                 joined.add(t_alias)
                 pending.remove(t_alias)
-                for _, e_ in mine[:2]:
-                    edges.remove(e_)
-                for (c1, c2), e_ in extra:
-                    post.append(
-                        ex.BinaryExpr(ex.ColumnRef(c1), "=", ex.ColumnRef(c2))
-                    )
+                for _, e_ in mine:
                     edges.remove(e_)
                 resolved = [
                     e_ for e_ in edges if e_[0] in joined and e_[2] in joined
