@@ -209,7 +209,7 @@ class Repartition(LogicalPlan):
 # Join
 # ---------------------------------------------------------------------------
 
-JOIN_TYPES = ("inner", "left", "right", "semi", "anti")
+JOIN_TYPES = ("inner", "left", "right", "full", "semi", "anti")
 
 
 @dataclass

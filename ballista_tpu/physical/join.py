@@ -13,7 +13,8 @@ path; duplicate build keys fall back to the expanding probe with adaptive
 output capacity.
 
 Join types: inner, left (preserves PROBE side — the planner picks which
-logical side becomes the probe accordingly), semi, anti.
+logical side becomes the probe accordingly), semi, anti, and full (a
+probe-preserving pass plus one batch of unmatched build rows).
 """
 
 from __future__ import annotations
@@ -30,7 +31,7 @@ from ..errors import ExecutionError, NotImplementedError_
 from ..kernels import join as join_k
 from .base import PhysicalPlan, Partitioning, concat_batches
 
-JOIN_TYPES = ("inner", "left", "semi", "anti")
+JOIN_TYPES = ("inner", "left", "semi", "anti", "full")
 
 
 class JoinExec(PhysicalPlan):
@@ -61,7 +62,9 @@ class JoinExec(PhysicalPlan):
         # reference, which always passes join children through unsplit
         # (reference: rust/scheduler/src/planner.rs:172-173).
         self.partitioned = partitioned
-        self._build_data = {}  # partition -> (table, batch, unique, has_null)
+        # partition -> (table, batch, unique, has_null, key mode,
+        #               codec tables, build keys, build live)
+        self._build_data = {}
         self._jit_probe = {}
         self._jit_codec_build = {}
         self._remap_cache = {}
@@ -182,6 +185,10 @@ class JoinExec(PhysicalPlan):
         return Schema(bf + extra)
 
     def output_partitioning(self) -> Partitioning:
+        if self.how == "full":
+            # one task streams every probe partition and appends the
+            # unmatched build rows (needs the global build-hit bitmap)
+            return Partitioning("unknown", 1)
         return self.probe.output_partitioning()
 
     def children(self):
@@ -247,12 +254,18 @@ class JoinExec(PhysicalPlan):
         nlive = int(table.num_live)
         unique = not bool(np.any(sk[1 : nlive] == sk[: nlive - 1])) if nlive > 1 else True
         self._build_data[key] = (table, bb, unique, has_null_key, mode,
-                                 key_tables)
+                                 key_tables, keys, live)
         return self._build_data[key]
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
-        table, build_batch, unique, has_null_key, mode, key_tables = \
-            self._materialize_build(partition)
+        (table, build_batch, unique, has_null_key, mode, key_tables,
+         bkeys, blive) = self._materialize_build(partition)
+        if self.how == "full":
+            if partition != 0:
+                raise ExecutionError("full outer join has a single partition")
+            yield from self._execute_full(table, build_batch, unique,
+                                          mode, key_tables, bkeys, blive)
+            return
         if self.how == "anti" and self.null_aware and has_null_key:
             # SQL NOT IN with a NULL in the subquery: predicate is never
             # true -> empty result
@@ -269,6 +282,73 @@ class JoinExec(PhysicalPlan):
             else:
                 yield from self._probe_expand_batch(table, build_batch, pb,
                                                     mode, key_tables, remaps)
+
+    # full outer ------------------------------------------------------------
+
+    def _execute_full(self, table, build_batch, unique, mode, key_tables,
+                      bkeys, blive):
+        """Probe-preserving (left) pass over every probe partition while
+        accumulating which build rows matched, then one extra batch of
+        unmatched build rows with null probe columns. The reference's
+        DataFrame layer left joins as a TODO entirely
+        (rust/client/src/context.rs:287-290)."""
+        hit = np.zeros(build_batch.capacity, bool)
+        nparts = self.probe.output_partitioning().num_partitions
+        for p in range(nparts):
+            for pb in self.probe.execute(p):
+                remaps = self._remaps_for(build_batch, pb)
+                if unique:
+                    yield self._probe_unique_batch(table, build_batch, pb,
+                                                   mode, key_tables, remaps)
+                else:
+                    yield from self._probe_expand_batch(
+                        table, build_batch, pb, mode, key_tables, remaps)
+                hit |= np.asarray(self._mark_hits(build_batch, pb, mode,
+                                                  key_tables, remaps,
+                                                  bkeys, blive))
+        # selection, not blive: build rows with NULL join keys can never
+        # match but SQL still emits them with null probe columns
+        unmatched = np.asarray(build_batch.selection) & ~hit
+        yield self._unmatched_build_batch(build_batch, jnp.asarray(unmatched))
+
+    def _mark_hits(self, build_batch, pb, mode, key_tables, remaps,
+                   bkeys, blive):
+        """bool [build_cap]: build rows whose key appears among this probe
+        batch's live keys (reverse membership probe; duplicates fine).
+        NOTE: redoes the probe-key extraction the main pass already did;
+        folding a build_rows scatter into the probe jits would halve the
+        full-join probe cost if it ever shows up in profiles."""
+        key = ("m", mode, pb.capacity, build_batch.capacity)
+        if key not in self._jit_probe:
+
+            def run(pb, key_tables, remaps, bkeys, blive):
+                pkeys, plive = self._probe_keys(pb, mode, key_tables, remaps)
+                pt = join_k.build_lookup(pkeys, plive)
+                _, matched = join_k.probe_unique(pt, bkeys, blive)
+                return jnp.logical_and(blive, matched)
+
+            self._jit_probe[key] = jax.jit(run)
+        return self._jit_probe[key](pb, key_tables, remaps, bkeys, blive)
+
+    def _unmatched_build_batch(self, bb: ColumnBatch,
+                               unmatched) -> ColumnBatch:
+        from ..columnar import Dictionary
+
+        schema = self.output_schema()
+        ps = self.probe.output_schema()
+        cols = []
+        for f in schema.fields:
+            if bb.schema.has_field(f.name):
+                cols.append(bb.column(f.name))
+            else:  # probe-only column: all-NULL
+                dt = ps.field(f.name).dtype
+                d = Dictionary([]) if dt.kind == "utf8" else None
+                cols.append(Column(
+                    jnp.zeros((bb.capacity,), dt.device_dtype()), dt,
+                    jnp.zeros((bb.capacity,), jnp.bool_), d,
+                ))
+        return ColumnBatch(schema, cols, unmatched,
+                           jnp.sum(unmatched).astype(jnp.int32))
 
     # fast path: unique build keys ------------------------------------------
 
@@ -380,7 +460,7 @@ class JoinExec(PhysicalPlan):
     def _probe_expand_batch(self, table, build_batch, pb: ColumnBatch,
                             mode: str, key_tables,
                             remaps) -> Iterator[ColumnBatch]:
-        if self.how not in ("inner", "left", "semi", "anti"):
+        if self.how not in ("inner", "left", "semi", "anti", "full"):
             raise NotImplementedError_(
                 f"{self.how} join with duplicate build keys"
             )
@@ -411,7 +491,7 @@ class JoinExec(PhysicalPlan):
                 break
             out_cap = round_capacity(t)
         yield out
-        if self.how == "left":
+        if self.how in ("left", "full"):
             # preserved probe rows with no match, null build columns
             key = ("l", mode, pb.capacity, build_batch.capacity)
             if key not in self._jit_probe:

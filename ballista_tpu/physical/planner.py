@@ -144,6 +144,11 @@ def _create(plan: LogicalPlan, opts: PlannerOptions) -> PhysicalPlan:
         elif plan.how == "right":
             build, probe, how = left, right, "left"
             on = list(plan.on)
+        elif plan.how == "full":
+            # build = right, probe = left; JoinExec streams every probe
+            # partition itself and appends the unmatched build rows
+            build, probe, how = right, left, "full"
+            on = [(r, l) for l, r in plan.on]
         elif plan.how in ("semi", "anti"):
             build, probe, how = right, left, plan.how
             on = [(r, l) for l, r in plan.on]
@@ -153,7 +158,8 @@ def _create(plan: LogicalPlan, opts: PlannerOptions) -> PhysicalPlan:
         # null-aware anti joins (NOT IN) must see the WHOLE build side:
         # one NULL subquery value empties every partition's result, so a
         # per-bucket build would miss nulls that hashed elsewhere
-        partitionable = not plan.null_aware and threshold is not None
+        partitionable = (not plan.null_aware and threshold is not None
+                         and how != "full")
         est = build.estimated_rows() if partitionable else None
         if partitionable and est is not None and est > threshold:
             # co-partitioned join: hash-shuffle BOTH sides on the join keys

@@ -242,8 +242,6 @@ class Parser:
                 self.next()
                 self.accept_kw("outer")
                 self.expect_kw("join")
-                if kw == "full":
-                    raise SqlError("FULL OUTER JOIN not supported yet")
                 return kw
         for kw in ("semi", "anti"):
             if self.peek().is_kw(kw):

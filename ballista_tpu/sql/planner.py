@@ -629,7 +629,7 @@ class SqlPlanner:
                     acc_unique = (
                         {rel.primary_key} if rel.primary_key else set()
                     )
-                elif how in ("left", "right"):
+                elif how in ("left", "right", "full"):
                     # outer joins: the accumulated side is the logical left
                     plan = Join(plan, t_plan, [(acc_col, t_col)], how)
                     acc_unique = set()

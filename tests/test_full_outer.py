@@ -1,0 +1,116 @@
+"""FULL OUTER JOIN (the reference leaves DataFrame joins TODO entirely,
+rust/client/src/context.rs:287-290; our parser previously raised)."""
+
+import numpy as np
+import pandas as pd
+
+from ballista_tpu import schema, Int64, Utf8
+from ballista_tpu.client import BallistaContext
+from ballista_tpu.io import MemTableSource
+
+
+def _ctx(tables):
+    ctx = BallistaContext.standalone()
+    for name, (s, data, parts) in tables.items():
+        ctx.register_source(
+            name, MemTableSource.from_pydict(s, data, num_partitions=parts)
+        )
+    return ctx
+
+
+def _check(got, exp, cols):
+    got = got.sort_values(cols).reset_index(drop=True)
+    exp = exp.sort_values(cols).reset_index(drop=True)
+    assert len(got) == len(exp), (len(got), len(exp))
+    for c in cols:
+        g = got[c].astype(float).to_numpy()
+        e = exp[c].astype(float).to_numpy()
+        np.testing.assert_array_equal(np.isnan(g), np.isnan(e), err_msg=c)
+        np.testing.assert_array_equal(g[~np.isnan(g)], e[~np.isnan(e)],
+                                      err_msg=c)
+
+
+def test_full_outer_join_basic():
+    left = {"k": np.array([1, 2, 3, 4]), "v": np.array([10, 20, 30, 40])}
+    right = {"j": np.array([3, 4, 5]), "w": np.array([300, 400, 500])}
+    ls = schema(("k", Int64), ("v", Int64))
+    rs = schema(("j", Int64), ("w", Int64))
+    ctx = _ctx({"l": (ls, left, 2), "r": (rs, right, 1)})
+    got = ctx.sql(
+        "select v, w from l full outer join r on k = j"
+    ).collect()
+    exp = pd.DataFrame(left).merge(pd.DataFrame(right), how="outer",
+                                   left_on="k", right_on="j")[["v", "w"]]
+    _check(got, exp, ["v", "w"])
+
+
+def test_full_outer_join_duplicates_and_multi_partition():
+    rng = np.random.default_rng(11)
+    left = {"k": rng.integers(0, 6, 40), "v": np.arange(40)}
+    right = {"j": rng.integers(3, 10, 25), "w": np.arange(100, 125)}
+    ls = schema(("k", Int64), ("v", Int64))
+    rs = schema(("j", Int64), ("w", Int64))
+    ctx = _ctx({"l": (ls, left, 3), "r": (rs, right, 2)})
+    got = ctx.sql("select v, w from l full join r on k = j").collect()
+    exp = pd.DataFrame(left).merge(pd.DataFrame(right), how="outer",
+                                   left_on="k", right_on="j")[["v", "w"]]
+    _check(got, exp, ["v", "w"])
+
+
+def test_full_outer_preserves_null_key_build_rows():
+    """A build row with a NULL join key matches nothing but must still
+    appear in the full outer result with null probe columns."""
+    import jax.numpy as jnp
+
+    from ballista_tpu.columnar import Column, ColumnBatch
+    from ballista_tpu.physical.join import JoinExec
+    from ballista_tpu.physical.operators import ScanExec
+
+    rs = schema(("j", Int64), ("w", Int64))
+    cap = 8
+    jvals = np.zeros(cap, np.int64)
+    jvals[:3] = [2, 0, 5]  # row 1's key is NULL (validity False)
+    wvals = np.zeros(cap, np.int64)
+    wvals[:3] = [200, 999, 500]
+    validity = np.zeros(cap, bool)
+    validity[:3] = [True, False, True]
+    sel = np.zeros(cap, bool)
+    sel[:3] = True
+    build_batch = ColumnBatch(
+        rs,
+        [Column(jnp.asarray(jvals), Int64, jnp.asarray(validity), None),
+         Column(jnp.asarray(wvals), Int64, None, None)],
+        jnp.asarray(sel), jnp.asarray(np.int32(3)),
+    )
+    build_src = MemTableSource(rs, [[build_batch]])
+
+    ls = schema(("k", Int64), ("v", Int64))
+    probe_src = MemTableSource.from_pydict(
+        ls, {"k": np.array([1, 2]), "v": np.array([10, 20])},
+        num_partitions=1,
+    )
+    j = JoinExec(ScanExec("r", build_src), ScanExec("l", probe_src),
+                 on=[("j", "k")], how="full")
+    rows = []
+    for b in j.execute(0):
+        d = b.to_pydict()
+        rows += list(zip(d["v"].tolist(), d["w"].tolist()))
+    # (10,NULL) unmatched probe, (20,200) matched, (NULL,999) NULL-key
+    # build row, (NULL,500) unmatched build row
+    assert len(rows) == 4, rows
+    ws = sorted(w for _, w in rows if not (isinstance(w, float) and np.isnan(w)))
+    assert ws == [200, 500, 999], rows
+
+
+def test_full_outer_join_utf8_key():
+    left = {"name": ["a", "b", "c"], "v": np.arange(3)}
+    right = {"label": ["b", "c", "d"], "w": np.array([1, 2, 3])}
+    ls = schema(("name", Utf8), ("v", Int64))
+    rs = schema(("label", Utf8), ("w", Int64))
+    ctx = _ctx({"l": (ls, left, 1), "r": (rs, right, 1)})
+    got = ctx.sql(
+        "select v, w from l full outer join r on name = label"
+    ).collect()
+    exp = pd.DataFrame(left).merge(pd.DataFrame(right), how="outer",
+                                   left_on="name", right_on="label")[["v", "w"]]
+    _check(got, exp, ["v", "w"])
