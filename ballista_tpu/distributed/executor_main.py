@@ -1,7 +1,8 @@
 """Executor binary: ``python -m ballista_tpu.distributed.executor_main``.
 
-(reference: rust/executor/src/main.rs:55-164 + executor_config_spec.toml —
-layered config via env BALLISTA_EXECUTOR_* < CLI flags; ``--local`` embeds
+(reference: rust/executor/src/main.rs:55-164 + executor_config_spec.toml
+— layered config: defaults < /etc/ballista-tpu/executor.toml <
+--config-file < env BALLISTA_EXECUTOR_* < CLI flags; ``--local`` embeds
 a standalone scheduler in-process like the reference's local mode.)
 """
 
@@ -9,79 +10,78 @@ from __future__ import annotations
 
 import argparse
 import logging
-import os
 import signal
 import sys
 
+from .config import layered_config
 
-def env_default(name: str, fallback):
-    v = os.environ.get(f"BALLISTA_EXECUTOR_{name.upper()}")
-    if v is None:
-        return fallback
-    return type(fallback)(v) if fallback is not None else v
+DEFAULTS = {
+    "namespace": "default",
+    "scheduler_host": "localhost",
+    "scheduler_port": 50050,
+    "bind_host": "localhost",
+    "external_host": "",
+    "port": 0,  # data-plane port (0 = ephemeral)
+    "work_dir": "",
+    "concurrent_tasks": 4,
+    "num_devices": 0,  # 0 = autodetect
+    "log_level": "INFO",
+}
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="ballista-tpu executor")
-    ap.add_argument("--namespace", default=env_default("namespace", "default"))
-    ap.add_argument("--scheduler-host",
-                    default=env_default("scheduler_host", "localhost"))
-    ap.add_argument("--scheduler-port", type=int,
-                    default=env_default("scheduler_port", 50050))
-    ap.add_argument("--bind-host", default=env_default("bind_host", "localhost"))
-    ap.add_argument("--external-host", default=env_default("external_host", ""))
-    ap.add_argument("--port", type=int, default=env_default("port", 0),
-                    help="data-plane port (0 = ephemeral)")
-    ap.add_argument("--work-dir", default=env_default("work_dir", ""))
-    ap.add_argument("--concurrent-tasks", type=int,
-                    default=env_default("concurrent_tasks", 4))
-    ap.add_argument("--num-devices", type=int,
-                    default=env_default("num_devices", 0),
-                    help="devices this executor owns (0 = autodetect)")
+    ap.add_argument("--config-file", default=None)
     ap.add_argument("--local", action="store_true",
                     help="embed a standalone scheduler in-process")
-    ap.add_argument("--log-level", default=env_default("log_level", "INFO"))
+    for key in DEFAULTS:
+        ap.add_argument("--" + key.replace("_", "-"), default=None)
     args = ap.parse_args(argv)
 
+    cfg = layered_config(
+        "executor", DEFAULTS, args.config_file,
+        cli={k: getattr(args, k) for k in DEFAULTS},
+    )
+
     logging.basicConfig(
-        level=args.log_level.upper(),
+        level=cfg["log_level"].upper(),
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
 
     from .executor import Executor, ExecutorConfig
 
-    scheduler_port = args.scheduler_port
+    scheduler_port = cfg["scheduler_port"]
     if args.local:
         from .scheduler import serve_scheduler
         from .state import MemoryBackend, SchedulerState
 
-        state = SchedulerState(MemoryBackend(), args.namespace)
+        state = SchedulerState(MemoryBackend(), cfg["namespace"])
         _server, _svc, scheduler_port = serve_scheduler(
             state, "localhost", 0
         )
         print(f"embedded scheduler on localhost:{scheduler_port}", flush=True)
 
-    num_devices = args.num_devices
+    num_devices = cfg["num_devices"]
     if not num_devices:
         import jax
 
         num_devices = len(jax.devices())
-    cfg = ExecutorConfig(
-        host=args.external_host or args.bind_host,
-        bind_host=args.bind_host,
-        port=args.port,
-        work_dir=args.work_dir or None,
-        concurrent_tasks=args.concurrent_tasks,
-        scheduler_host="localhost" if args.local else args.scheduler_host,
+    exec_cfg = ExecutorConfig(
+        host=cfg["external_host"] or cfg["bind_host"],
+        bind_host=cfg["bind_host"],
+        port=cfg["port"],
+        work_dir=cfg["work_dir"] or None,
+        concurrent_tasks=cfg["concurrent_tasks"],
+        scheduler_host="localhost" if args.local else cfg["scheduler_host"],
         scheduler_port=scheduler_port,
         num_devices=num_devices,
     )
-    executor = Executor(cfg)
+    executor = Executor(exec_cfg)
     executor.start()
     print(
         f"ballista-tpu executor {executor.id[:8]} polling "
-        f"{cfg.scheduler_host}:{cfg.scheduler_port}, data plane on "
-        f"{cfg.host}:{executor.port}, work_dir={cfg.work_dir}",
+        f"{exec_cfg.scheduler_host}:{exec_cfg.scheduler_port}, data plane on "
+        f"{exec_cfg.host}:{executor.port}, work_dir={exec_cfg.work_dir}",
         flush=True,
     )
     stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])

@@ -1,52 +1,68 @@
 """Scheduler binary: ``python -m ballista_tpu.distributed.scheduler_main``.
 
 (reference: rust/scheduler/src/main.rs:43-115 + scheduler_config_spec.toml
-— layered config: defaults < env BALLISTA_SCHEDULER_* < CLI flags.)
+— layered config: defaults < /etc/ballista-tpu/scheduler.toml <
+--config-file < env BALLISTA_SCHEDULER_* < CLI flags.)
 """
 
 from __future__ import annotations
 
 import argparse
 import logging
-import os
 import signal
 import sys
 
+from .config import layered_config
 
-def env_default(name: str, fallback):
-    v = os.environ.get(f"BALLISTA_SCHEDULER_{name.upper()}")
-    if v is None:
-        return fallback
-    return type(fallback)(v) if fallback is not None else v
+DEFAULTS = {
+    "namespace": "default",
+    "bind_host": "0.0.0.0",
+    "port": 50050,
+    "config_backend": "memory",  # memory | sqlite | etcd
+    "sqlite_path": "ballista-state.db",
+    "etcd_urls": "localhost:2379",
+    "log_level": "INFO",
+}
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="ballista-tpu scheduler")
-    ap.add_argument("--namespace", default=env_default("namespace", "default"))
-    ap.add_argument("--bind-host", default=env_default("bind_host", "0.0.0.0"))
-    ap.add_argument("--port", type=int, default=env_default("port", 50050))
-    ap.add_argument("--config-backend", default=env_default("config_backend", "memory"),
-                    choices=["memory", "sqlite"])
-    ap.add_argument("--sqlite-path", default=env_default("sqlite_path", "ballista-state.db"))
-    ap.add_argument("--log-level", default=env_default("log_level", "INFO"))
+    ap.add_argument("--config-file", default=None)
+    for key in DEFAULTS:
+        ap.add_argument("--" + key.replace("_", "-"), default=None)
     args = ap.parse_args(argv)
 
+    cfg = layered_config(
+        "scheduler", DEFAULTS, args.config_file,
+        cli={k: getattr(args, k) for k in DEFAULTS},
+    )
+    backends = ("memory", "sqlite", "etcd")
+    if cfg["config_backend"] not in backends:
+        # validate post-layering so env/TOML typos fail loudly instead of
+        # silently falling back to the in-memory backend
+        ap.error(f"config_backend must be one of {backends}, "
+                 f"got {cfg['config_backend']!r}")
+
     logging.basicConfig(
-        level=args.log_level.upper(),
+        level=cfg["log_level"].upper(),
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
     from .scheduler import serve_scheduler
     from .state import MemoryBackend, SchedulerState, SqliteBackend
 
-    backend = (
-        SqliteBackend(args.sqlite_path)
-        if args.config_backend == "sqlite"
-        else MemoryBackend()
-    )
-    state = SchedulerState(backend, args.namespace)
-    server, _svc, port = serve_scheduler(state, args.bind_host, args.port)
-    print(f"ballista-tpu scheduler listening on {args.bind_host}:{port} "
-          f"(backend={args.config_backend}, ns={args.namespace})", flush=True)
+    if cfg["config_backend"] == "sqlite":
+        backend = SqliteBackend(cfg["sqlite_path"])
+    elif cfg["config_backend"] == "etcd":
+        from .etcd import EtcdBackend
+
+        backend = EtcdBackend(cfg["etcd_urls"])
+    else:
+        backend = MemoryBackend()
+    state = SchedulerState(backend, cfg["namespace"])
+    server, _svc, port = serve_scheduler(state, cfg["bind_host"], cfg["port"])
+    print(f"ballista-tpu scheduler listening on {cfg['bind_host']}:{port} "
+          f"(backend={cfg['config_backend']}, ns={cfg['namespace']})",
+          flush=True)
     stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
     print(f"signal {stop}; shutting down", flush=True)
     server.stop(grace=2)
