@@ -1,0 +1,102 @@
+"""Cross-engine comparison harness.
+
+The reference ships a Spark comparison harness (reference:
+spark/benchmarks/src/main/scala/.../Main.scala:24-121 — same tables and
+queries through a Spark session, timed). No Spark exists in this
+environment, so the comparison engine is pandas (the same independent
+implementations that serve as correctness oracles): every query runs
+through BOTH engines on identical data, results are cross-checked, and
+per-query timings are reported side by side.
+
+Usage:
+  python -m benchmarks.compare --path bench_data/sf02 [--queries 1,5,18]
+         [--iterations 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--path", required=True)
+    ap.add_argument("--format", default="tbl")
+    ap.add_argument("--queries", default=",".join(str(i) for i in range(1, 23)))
+    ap.add_argument("--iterations", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from ballista_tpu.client import BallistaContext
+    from benchmarks.tpch import oracle
+    from benchmarks.tpch.schema_def import register_tpch
+
+    ctx = BallistaContext.standalone()
+    register_tpch(ctx, args.path, args.format, cached=True)
+    tables = oracle.load_tables(args.path)
+    qdir = os.path.join(os.path.dirname(__file__), "tpch", "queries")
+
+    rows = []
+    print(f"{'query':>6} | {'ballista-tpu (s)':>16} | {'pandas (s)':>10} "
+          f"| {'speedup':>7} | match")
+    print("-" * 60)
+    for q in args.queries.split(","):
+        qname = f"q{q}"
+        sql = open(os.path.join(qdir, f"{qname}.sql")).read()
+        df = ctx.sql(sql)
+        df.collect()  # warm (compile + caches), like Spark harness reruns
+        bt = min(_timed(df.collect) for _ in range(args.iterations))
+        oracle_fn = oracle.ORACLES[qname]
+        oracle_fn(tables)
+        pt = min(_timed(lambda: oracle_fn(tables))
+                 for _ in range(args.iterations))
+        got = df.collect().reset_index(drop=True)
+        exp = oracle_fn(tables).reset_index(drop=True)
+        match = len(got) == len(exp)
+        if match:
+            for c in exp.columns:
+                g, e = got[c], exp[c]
+                try:
+                    if e.dtype.kind in "fc":
+                        np.testing.assert_allclose(
+                            g.astype(float), e.astype(float),
+                            rtol=1e-6, atol=1e-6)
+                    else:
+                        np.testing.assert_array_equal(g.to_numpy(),
+                                                      e.to_numpy())
+                except AssertionError:
+                    match = False
+                    break
+        speed = pt / bt if bt > 0 else float("inf")
+        rows.append({"query": qname, "ballista_s": round(bt, 3),
+                     "pandas_s": round(pt, 3), "speedup": round(speed, 2),
+                     "match": match})
+        print(f"{qname:>6} | {bt:16.3f} | {pt:10.3f} | {speed:6.2f}x "
+              f"| {'OK' if match else 'MISMATCH'}")
+
+    total_b = sum(r["ballista_s"] for r in rows)
+    total_p = sum(r["pandas_s"] for r in rows)
+    print("-" * 60)
+    print(f"{'total':>6} | {total_b:16.3f} | {total_p:10.3f} "
+          f"| {total_p / total_b:6.2f}x | "
+          f"{'all OK' if all(r['match'] for r in rows) else 'MISMATCHES'}")
+    print(json.dumps({"total_ballista_s": round(total_b, 2),
+                      "total_pandas_s": round(total_p, 2),
+                      "speedup": round(total_p / total_b, 2),
+                      "all_match": all(r["match"] for r in rows),
+                      "rows": rows}))
+    return 0
+
+
+def _timed(fn) -> float:
+    t0 = time.time()
+    fn()
+    return time.time() - t0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
