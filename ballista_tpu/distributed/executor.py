@@ -140,7 +140,10 @@ class Executor:
                 self._report_completed(pid, stats)
             except Exception as e:  # noqa: BLE001 - task failure
                 log.exception("task %s failed", pid)
-                self._report_failed(pid, str(e))
+                # prefix the exception class: the scheduler retries
+                # transient (IO-shaped) failures but fails fast on
+                # deterministic ones (bad plans, overflow limits)
+                self._report_failed(pid, f"{type(e).__name__}: {e}")
             finally:
                 self._slots.release()
 

@@ -230,10 +230,13 @@ class SchedulerService:
             jobs_touched.add(st.partition.job_id)
             if st.state == "completed":
                 self.state.task_completed(st)
-            elif st.state == "failed" and self.state.recover_fetch_failure(st):
+            elif st.state == "failed" and (
+                self.state.recover_fetch_failure(st)
+                or self.state.recover_transient_failure(st)
+            ):
                 log.warning(
-                    "recovering job %s: lost shuffle data for task %s — "
-                    "re-queued producer partitions (%s)",
+                    "recovering job %s: task %s failed transiently — "
+                    "re-queued (%s)",
                     st.partition.job_id, st.partition.key(), st.error,
                 )
             else:

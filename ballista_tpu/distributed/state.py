@@ -465,6 +465,31 @@ class SchedulerState:
             self._enqueue_stage(job_id, dep_stage)
         return True
 
+    # error-class prefixes considered transient (executor tags failures
+    # with the exception class name); deterministic failures — plan bugs,
+    # capacity limits — fail fast like the reference
+    TRANSIENT_ERRORS = ("IoError:", "OSError:", "ConnectionError:",
+                        "ConnectionResetError:", "ConnectionRefusedError:",
+                        "TimeoutError:", "BrokenPipeError:")
+
+    def recover_transient_failure(self, st: TaskStatus) -> bool:
+        """Re-queue a task that failed with an IO-shaped (transient)
+        error, within the job's recovery budget. The reference fails the
+        whole job on ANY task failure (state/mod.rs:342-346)."""
+        err = st.error or ""
+        if not err.startswith(self.TRANSIENT_ERRORS):
+            return False
+        with self._lock:
+            if (st.partition.job_id, st.partition.stage_id) not in \
+                    self._stage_parts:
+                return False
+            if self._bump_recovery(st.partition.job_id) > \
+                    self.MAX_RECOVERIES_PER_JOB:
+                return False
+            self._reset_task(st.partition)
+            self._enqueue_stage(st.partition.job_id, st.partition.stage_id)
+        return True
+
     def reap_lost_tasks(self, min_interval_secs: float = 5.0) -> List[str]:
         """Re-queue running tasks whose executor's lease has expired (the
         executor died mid-task; its completion report will never arrive).

@@ -67,9 +67,13 @@ class ShuffleFetchError(IoError):
 
     @classmethod
     def parse(cls, message: str):
-        """Returns (stage_id, [partition_ids], executor_id) or None."""
-        if not message or not message.startswith(cls.PREFIX):
+        """Returns (stage_id, [partition_ids], executor_id) or None. The
+        tag is located anywhere in the message (reporters may prefix the
+        exception class name)."""
+        idx = (message or "").find(cls.PREFIX)
+        if idx < 0:
             return None
+        message = message[idx:]
         try:
             fields = dict(
                 kv.split("=", 1)

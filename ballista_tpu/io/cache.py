@@ -24,6 +24,9 @@ class CacheSource(TableSource):
     def source_descriptor(self) -> dict:
         return self.inner.source_descriptor()
 
+    def estimated_rows(self):
+        return self.inner.estimated_rows()
+
     def scan(self, partition: int, projection: Optional[Sequence[str]] = None):
         key = (partition, tuple(projection) if projection is not None else None)
         if key not in self._cache:

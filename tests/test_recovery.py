@@ -178,6 +178,38 @@ def test_retry_budget_exhaustion_fails_job(tmp_path):
     assert not state.recover_fetch_failure(consumer)
 
 
+def test_transient_task_failure_requeued():
+    """IO-shaped task failures re-queue within budget; deterministic ones
+    fail fast (the reference fails the job on any failure)."""
+    from ballista_tpu.distributed.types import JobStatus
+
+    state = SchedulerState(MemoryBackend())
+    state.save_job_status("j000003", JobStatus("running"))
+    state.save_stage_plan("j000003", 1, b"", 1, [])
+    pid = PartitionId("j000003", 1, 0)
+
+    transient = TaskStatus(pid, "failed", error="IoError: disk hiccup")
+    assert state.recover_transient_failure(transient)
+    assert state.next_task() == pid
+    assert state.get_task_statuses("j000003", 1)[0].state is None
+
+    deterministic = TaskStatus(pid, "failed",
+                               error="ExecutionError: capacity exceeded")
+    assert not state.recover_transient_failure(deterministic)
+
+    # budget: repeated transient failures eventually fail
+    for _ in range(state.MAX_RECOVERIES_PER_JOB - 1):
+        assert state.recover_transient_failure(transient)
+    assert not state.recover_transient_failure(transient)
+
+
+def test_shuffle_fetch_error_parse_with_class_prefix():
+    e = ShuffleFetchError(3, [1, 2], "ex1", "connection refused")
+    prefixed = f"{type(e).__name__}: {e}"
+    assert ShuffleFetchError.parse(prefixed) == (3, [1, 2], "ex1")
+    assert ShuffleFetchError.parse("ExecutionError: nope") is None
+
+
 def test_reap_requeues_running_tasks_of_dead_executor(tmp_path):
     from ballista_tpu.distributed.types import ExecutorMeta, JobStatus
 
