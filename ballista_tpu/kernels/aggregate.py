@@ -199,12 +199,38 @@ def _min_ident(dt):
 # ---------------------------------------------------------------------------
 
 
+def _pallas_mode() -> str:
+    """'' (off) | 'on' (real TPU) | 'interpret' (CI validation)."""
+    import os
+
+    return os.environ.get("BALLISTA_PALLAS", "").lower()
+
+
+def _pallas_eligible(aggs: Sequence[AggInput]) -> bool:
+    """The Pallas fast path covers validity-free integer sums and
+    count(*) — exactly TPC-H q1's shape. Anything else falls back."""
+    for a in aggs:
+        if a.validity is not None:
+            return False
+        if a.op == "count":
+            continue
+        if a.op != "sum" or a.values is None or \
+                not jnp.issubdtype(a.values.dtype, jnp.integer):
+            return False
+    return True
+
+
 def dense_grouped_aggregate(
     gids: jax.Array,  # int32 [N] in [0, num_groups)
     live: jax.Array,  # bool [N]
     aggs: Sequence[AggInput],
     num_groups: int,
 ) -> GroupedResult:
+    mode = _pallas_mode()
+    if mode in ("on", "1", "interpret") and _pallas_eligible(aggs) and \
+            any(a.op == "sum" for a in aggs):
+        return _dense_grouped_pallas(gids, live, aggs, num_groups,
+                                     interpret=(mode == "interpret"))
     n = gids.shape[0]
     groups = jnp.arange(num_groups, dtype=jnp.int32)
     # [N, G] membership mask, fused into each reduction (never materialized
@@ -243,6 +269,36 @@ def dense_grouped_aggregate(
 
     return GroupedResult(rep_indices, group_valid, num_present, results,
                          valid_results)
+
+
+def _dense_grouped_pallas(gids, live, aggs, num_groups,
+                          interpret: bool) -> GroupedResult:
+    """Sums/counts via the fused Pallas kernel (kernels/pallas_agg.py);
+    representatives/validity via cheap XLA ops."""
+    from .pallas_agg import dense_grouped_sums
+
+    values = [a.values.astype(jnp.int64) for a in aggs if a.op == "sum"]
+    sums, counts = dense_grouped_sums(gids, live, values, num_groups,
+                                      interpret=interpret)
+    n = gids.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    first = jax.ops.segment_min(jnp.where(live, pos, n), gids,
+                                num_segments=num_groups)
+    rep_indices = jnp.minimum(first, n - 1).astype(jnp.int32)
+    group_valid = counts > 0
+    num_present = jnp.sum(group_valid.astype(jnp.int32))
+    results: List[jax.Array] = []
+    si = 0
+    for a in aggs:
+        if a.op == "count":
+            results.append(counts)
+        else:
+            out = sums[si].astype(a.values.dtype)
+            results.append(jnp.where(group_valid, out,
+                                     jnp.zeros((), out.dtype)))
+            si += 1
+    return GroupedResult(rep_indices, group_valid, num_present, results,
+                         [group_valid] * len(aggs))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,103 @@
+"""Pallas TPU kernel: fused dense grouped accumulation (q1's hot loop).
+
+Replaces the XLA `dense_grouped_aggregate` inner loop — one pass over the
+batch computing every group's sums and counts — with a single Pallas
+kernel so the row -> group scatter never materializes [N, G] masks in
+HBM (the role a hand-written Rust hash-aggregate loop plays in the
+reference's DataFusion engine; here it is a TPU kernel, not CPU code).
+
+Exactness without i64 vectors: Mosaic has no 64-bit vector ops, so each
+scaled-decimal int64 value is split into three limbs (16+16+32-bit,
+arithmetic shift keeps the sign in the top limb) and accumulated in
+int32 per block — safe because a block's limb sum is bounded by
+BLOCK * 2^16 < 2^31 — then the per-block partials are recombined in
+int64 by XLA: sum(v) = sum(l0) + (sum(l1) << 16) + (sum(l2) << 32).
+Values must fit |v| < 2^47 (checked by the caller's decimal scales).
+
+Developed and tested in interpret mode (no TPU in CI); enable on-chip
+via BALLISTA_PALLAS=1 once measured (kernels/aggregate.py gates it).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+BLOCK = 1024  # rows per grid step; limb sums stay < 2^31
+
+
+def _limbs(v: jax.Array) -> List[jax.Array]:
+    """int64 [N] -> three int32 [N] limbs (16/16/32, sign in the top)."""
+    l0 = (v & jnp.int64(0xFFFF)).astype(jnp.int32)
+    l1 = ((v >> 16) & jnp.int64(0xFFFF)).astype(jnp.int32)
+    l2 = (v >> 32).astype(jnp.int32)  # arithmetic shift: carries the sign
+    return [l0, l1, l2]
+
+
+def _kernel(gid_ref, live_ref, limb_ref, out_ref, *, num_groups: int,
+            n_cols: int):
+    """One grid step: accumulate this block's rows into per-group
+    partial sums. out block: [1, num_groups, n_cols + 1] int32 (the last
+    column counts live rows)."""
+    gids = gid_ref[...]  # [BLOCK] int32
+    live = live_ref[...]  # [BLOCK] int32 (0/1)
+    limbs = limb_ref[...]  # [BLOCK, n_cols] int32
+    for g in range(num_groups):  # static unroll: VPU masked reductions
+        mask = jnp.logical_and(gids == g, live > 0)
+        masked = jnp.where(mask[:, None], limbs, 0)
+        out_ref[0, g, :n_cols] = jnp.sum(masked, axis=0)
+        out_ref[0, g, n_cols] = jnp.sum(mask.astype(jnp.int32))
+
+
+def dense_grouped_sums(
+    gids: jax.Array,  # int32 [N] in [0, num_groups)
+    live: jax.Array,  # bool [N]
+    values: Sequence[jax.Array],  # int64 [N] each (|v| < 2^47)
+    num_groups: int,
+    interpret: bool = False,
+):
+    """Returns (sums: list of int64 [G], counts: int64 [G])."""
+    from jax.experimental import pallas as pl
+
+    if not values:
+        raise ValueError("dense_grouped_sums needs at least one value column")
+    n = gids.shape[0]
+    pad = (-n) % BLOCK
+    if pad:
+        gids = jnp.pad(gids, (0, pad))
+        live = jnp.pad(live, (0, pad))
+        values = [jnp.pad(v, (0, pad)) for v in values]
+        n += pad
+    n_blocks = n // BLOCK
+    n_cols = 3 * len(values)
+    limbs = jnp.stack([l for v in values for l in _limbs(v)], axis=1)
+
+    partials = pl.pallas_call(
+        partial(_kernel, num_groups=num_groups, n_cols=n_cols),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((BLOCK,), lambda b: (b,)),
+            pl.BlockSpec((BLOCK,), lambda b: (b,)),
+            pl.BlockSpec((BLOCK, n_cols), lambda b: (b, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, num_groups, n_cols + 1), lambda b: (b, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_blocks, num_groups, n_cols + 1), jnp.int32
+        ),
+        interpret=interpret,
+    )(gids, live.astype(jnp.int32), limbs)
+
+    totals = jnp.sum(partials.astype(jnp.int64), axis=0)  # [G, C+1]
+    sums = []
+    for i in range(len(values)):
+        l0 = totals[:, 3 * i]
+        l1 = totals[:, 3 * i + 1]
+        l2 = totals[:, 3 * i + 2]
+        sums.append(l0 + (l1 << 16) + (l2 << 32))
+    counts = totals[:, n_cols]
+    return sums, counts
