@@ -125,8 +125,12 @@ def _job_id() -> str:
 
 
 class SchedulerService:
-    def __init__(self, state: SchedulerState):
+    def __init__(self, state: SchedulerState,
+                 speculation_age_secs: float = 60.0):
         self.state = state
+        # duplicate straggler tasks older than this when executors idle;
+        # 0 disables
+        self.speculation_age_secs = speculation_age_secs
 
     # -- RPC: ExecuteQuery --------------------------------------------------
 
@@ -244,6 +248,13 @@ class SchedulerService:
         result = pb.PollWorkResult()
         if request.can_accept_task:
             task = self.state.next_task(meta.num_devices)
+            if task is None and self.speculation_age_secs > 0:
+                task = self.state.speculative_task(
+                    meta.num_devices, self.speculation_age_secs
+                )
+                if task is not None:
+                    log.warning("speculating straggler task %s on executor "
+                                "%s", task.key(), meta.id)
             if task is not None:
                 try:
                     result.task.CopyFrom(self._task_definition(task, meta))
@@ -278,7 +289,8 @@ class SchedulerService:
                     )
             plan = remove_unresolved_shuffles(plan, locations)
         self.state.save_task_status(
-            TaskStatus(task, "running", executor_id=meta.id)
+            TaskStatus(task, "running", executor_id=meta.id,
+                       started_at=time.time())
         )
         td = pb.TaskDefinition()
         td.task_id.job_id = task.job_id
@@ -402,9 +414,10 @@ _RPCS = {
 
 
 def serve_scheduler(state: SchedulerState, host: str = "0.0.0.0",
-                    port: int = 50050, max_workers: int = 16):
+                    port: int = 50050, max_workers: int = 16,
+                    speculation_age_secs: float = 60.0):
     """Start the scheduler gRPC server; returns (grpc_server, service)."""
-    svc = SchedulerService(state)
+    svc = SchedulerService(state, speculation_age_secs=speculation_age_secs)
     handlers = {}
     for name, (req_t, _resp_t) in _RPCS.items():
         handlers[name] = grpc.unary_unary_rpc_method_handler(

@@ -21,6 +21,7 @@ DEFAULTS = {
     "config_backend": "memory",  # memory | sqlite | etcd
     "sqlite_path": "ballista-state.db",
     "etcd_urls": "localhost:2379",
+    "speculation_secs": 60,  # duplicate stragglers after this; 0 = off
     "log_level": "INFO",
 }
 
@@ -59,7 +60,10 @@ def main(argv=None) -> int:
     else:
         backend = MemoryBackend()
     state = SchedulerState(backend, cfg["namespace"])
-    server, _svc, port = serve_scheduler(state, cfg["bind_host"], cfg["port"])
+    server, _svc, port = serve_scheduler(
+        state, cfg["bind_host"], cfg["port"],
+        speculation_age_secs=float(cfg["speculation_secs"]),
+    )
     print(f"ballista-tpu scheduler listening on {cfg['bind_host']}:{port} "
           f"(backend={cfg['config_backend']}, ns={cfg['namespace']})",
           flush=True)

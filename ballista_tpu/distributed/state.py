@@ -490,6 +490,33 @@ class SchedulerState:
             self._enqueue_stage(st.partition.job_id, st.partition.stage_id)
         return True
 
+    def speculative_task(self, num_devices: int = 0,
+                         age_secs: float = 60.0) -> Optional[PartitionId]:
+        """Straggler mitigation the reference lacks entirely: when an
+        executor is idle and nothing is ready, hand out a DUPLICATE of a
+        long-running task (first completion wins — stage outputs are
+        per-executor files, so the recorded completion's location is
+        self-consistent). Each task is duplicated at most once."""
+        now = time.time()
+        self._speculated = getattr(self, "_speculated", set())
+        for k, v in self.kv.get_from_prefix(self._k("jobs")):
+            if pickle.loads(v).state not in ("queued", "running"):
+                continue
+            job_id = k.rsplit("/", 1)[1]
+            with self._lock:
+                for t in self.get_task_statuses(job_id):
+                    key = t.partition
+                    if (t.state == "running" and t.started_at
+                            and now - t.started_at > age_secs
+                            and key not in self._speculated):
+                        need = self._stage_mesh.get(
+                            (job_id, t.partition.stage_id), 0)
+                        if need and num_devices and num_devices < need:
+                            continue
+                        self._speculated.add(key)
+                        return t.partition
+        return None
+
     def reap_lost_tasks(self, min_interval_secs: float = 5.0) -> List[str]:
         """Re-queue running tasks whose executor's lease has expired (the
         executor died mid-task; its completion report will never arrive).

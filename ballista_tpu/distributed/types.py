@@ -48,6 +48,8 @@ class TaskStatus:
     error: Optional[str] = None
     path: Optional[str] = None
     stats: Optional[Dict[str, int]] = None
+    # assignment wall time; drives straggler detection (speculation)
+    started_at: Optional[float] = None
 
 
 @dataclass

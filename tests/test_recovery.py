@@ -210,6 +210,42 @@ def test_shuffle_fetch_error_parse_with_class_prefix():
     assert ShuffleFetchError.parse("ExecutionError: nope") is None
 
 
+def test_speculative_execution_of_stragglers(tmp_path):
+    """An idle executor gets a DUPLICATE of a long-running task (the
+    reference has no speculation at all); first completion wins."""
+    svc = SchedulerService(SchedulerState(MemoryBackend()),
+                           speculation_age_secs=0.05)
+    e1 = _make_executor(tmp_path, "e1")
+    e2 = _make_executor(tmp_path, "e2")
+    try:
+        job_id = _submit_groupby(svc, _source(tmp_path))
+        # e1 takes both producer tasks but "hangs" (never reports back):
+        # poll directly so the tasks are assigned without executing
+        for _ in range(2):
+            params = pb.PollWorkParams(can_accept_task=True)
+            params.metadata.id = e1.id
+            params.metadata.host = "localhost"
+            params.metadata.port = e1.port
+            params.metadata.num_devices = 1
+            assert svc.PollWork(params).HasField("task")
+        time.sleep(0.1)  # exceed the straggler threshold
+
+        # e2 polls: ready queue is empty, so it receives DUPLICATES of
+        # e1's stuck tasks and actually runs them
+        ran = [_pump(svc, e2), _pump(svc, e2)]
+        assert all(r is not None for r in ran)
+        for _ in range(6):
+            _pump(svc, e2)
+            if svc.state.get_job_status(job_id).state == "completed":
+                break
+        assert svc.state.get_job_status(job_id).state == "completed"
+        # each task is duplicated at most once
+        assert svc.state.speculative_task(age_secs=0.0) is None
+    finally:
+        for e in (e1, e2):
+            e._data_plane.close()
+
+
 def test_reap_requeues_running_tasks_of_dead_executor(tmp_path):
     from ballista_tpu.distributed.types import ExecutorMeta, JobStatus
 
