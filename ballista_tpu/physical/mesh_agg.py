@@ -144,8 +144,9 @@ class MeshAggExec(PhysicalPlan):
 
     def _spmd(self, stacked, mesh, cap: int, in_cap: int):
         """(stacked batch pytree) -> (stacked out batch, num_groups[n])."""
-        from jax import shard_map
         from functools import partial
+
+        from ..parallel.mesh import shard_map  # version-guarded import
 
         n_dev = self.n_devices
         fields = self._partial_schema.fields

@@ -314,6 +314,33 @@ class RepartitionExec(PhysicalPlan):
             self._cache = out
         return self._cache
 
+    def _materialize_parts(self):
+        """Materialize once and sort each batch by destination partition
+        ONCE (not once per output partition): partition p is then a
+        contiguous slice of the permutation. [(batch, perm, counts)]"""
+        if getattr(self, "_parts", None) is None:
+            if self._jit_mask is None:
+                n_out = self.num_partitions
+
+                def sort_by_pid(b: ColumnBatch, offset):
+                    pids = self.partition_ids(b, offset)
+                    d = jnp.where(b.selection, pids, n_out)  # dead last
+                    idx = jnp.arange(b.capacity, dtype=jnp.int32)
+                    _, perm = jax.lax.sort((d, idx), num_keys=1,
+                                           is_stable=True)
+                    counts = jnp.bincount(d, length=n_out + 1)[:n_out]
+                    return perm, counts
+
+                self._jit_mask = jax.jit(sort_by_pid)
+            parts = []
+            offset = 0
+            for batch in self._materialize():
+                perm, counts = self._jit_mask(batch, jnp.int32(offset))
+                parts.append((batch, perm, np.asarray(counts)))
+                offset += batch.num_rows_host()
+            self._parts = parts
+        return self._parts
+
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
         """Yields COMPACTED batches: rows of the requested partition are
         gathered to the front and the capacity shrinks to fit, so a
@@ -321,35 +348,25 @@ class RepartitionExec(PhysicalPlan):
         work per partition instead of re-touching full-capacity masked
         batches. Mirrors the distributed path, where shuffle files are
         mask-compacted on IPC write."""
-        if self._jit_mask is None:
-
-            def mask_count(b: ColumnBatch, offset, p):
-                pids = self.partition_ids(b, offset)
-                sel = jnp.logical_and(b.selection, pids == p)
-                # stable sort sinks non-selected rows to the back
-                perm = jnp.argsort(jnp.logical_not(sel), stable=True)
-                return perm, jnp.sum(sel.astype(jnp.int32))
-
-            self._jit_mask = jax.jit(mask_count)
         self._jit_take = getattr(self, "_jit_take", {})
-        offset = 0
-        for batch in self._materialize():
-            perm, count = self._jit_mask(batch, jnp.int32(offset),
-                                         jnp.int32(partition))
-            n = int(count)
-            # never exceed the source capacity: perm has batch.capacity
-            # entries, and a longer slice would silently clamp
+        for batch, perm, counts in self._materialize_parts():
+            n = int(counts[partition])
+            start = int(counts[:partition].sum())
+            # never exceed the source capacity: a longer slice would
+            # silently clamp
             cap = min(round_capacity(n), batch.capacity)
+            idx = perm[start:start + cap]
+            if int(idx.shape[0]) < cap:  # tail partition: pad the gather
+                idx = jnp.pad(idx, (0, cap - int(idx.shape[0])))
             key = (batch.capacity, cap)
             if key not in self._jit_take:
 
-                def take_front(b, perm, n, _cap=cap):
+                def take_front(b, idx, n, _cap=cap):
                     live = jnp.arange(_cap, dtype=jnp.int32) < n
-                    return take_batch(b, perm[:_cap], live)
+                    return take_batch(b, idx, live)
 
                 self._jit_take[key] = jax.jit(take_front)
-            yield self._jit_take[key](batch, perm, jnp.int32(n))
-            offset += batch.num_rows_host()
+            yield self._jit_take[key](batch, idx, jnp.int32(n))
 
     def display(self) -> str:
         k = "hash" if self.hash_exprs else "round-robin"
