@@ -6,6 +6,8 @@ way the native C++ server does (shuffle_server.cpp path_component_ok), and
 IPC reads must keep int64/scaled-decimal values exact (no float64 detours).
 """
 
+import os
+
 import numpy as np
 import jax.numpy as jnp
 import pytest
@@ -58,6 +60,64 @@ def test_data_plane_rejects_traversal_job_id(tmp_path):
             )
     finally:
         server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# native C++ data-plane server: protocol + hardening parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cpp_server_bin():
+    import subprocess
+
+    native = os.path.join(os.path.dirname(__file__), "..", "ballista_tpu",
+                          "native")
+    r = subprocess.run(["make", "-C", native, "shuffle_server"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    return os.path.join(native, "shuffle_server")
+
+
+def test_cpp_shuffle_server_protocol_parity(cpp_server_bin, tmp_path):
+    """The C++ server must be a drop-in for the Python one: same wire
+    protocol, same path layout, same job-id hardening."""
+    import subprocess
+    import time
+
+    from ballista_tpu import schema, Int64 as I64
+    from ballista_tpu.columnar import ColumnBatch
+
+    work = tmp_path / "work"
+    s = schema(("v", Int64))
+    batch = ColumnBatch.from_pydict(s, {"v": [7, 8, 9]})
+    ipc.write_partition(
+        str(work / "jobx" / "1" / "0" / "data.arrow"), [batch])
+    ipc.write_partition(
+        str(work / "jobx" / "1" / "0" / "shuffle-2.arrow"), [batch])
+
+    proc = subprocess.Popen([cpp_server_bin, "0", str(work)],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        port = int(line.split("port")[1].split()[0])
+        # partition fetch
+        buf = dataplane.fetch_partition_bytes("localhost", port, "jobx", 1, 0)
+        _, arrays, _, _, _ = ipc.read_partition_arrays(buf)
+        assert list(arrays["v"]) == [7, 8, 9]
+        # shuffle fetch
+        buf = dataplane.fetch_partition_bytes("localhost", port, "jobx", 1, 0,
+                                              shuffle_output=2)
+        _, arrays, _, _, _ = ipc.read_partition_arrays(buf)
+        assert list(arrays["v"]) == [7, 8, 9]
+        # traversal hardening matches the Python server
+        with pytest.raises(IoError, match="bad job id"):
+            dataplane.fetch_partition_bytes("localhost", port, "../etc", 1, 0)
+        with pytest.raises(IoError, match="no such|bad"):
+            dataplane.fetch_partition_bytes("localhost", port, "missing", 1, 0)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
 
 
 # ---------------------------------------------------------------------------
