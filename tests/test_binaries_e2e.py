@@ -1,0 +1,79 @@
+"""Process-level integration: the real scheduler and executor BINARIES
+(separate processes, real gRPC control plane, real socket data plane)
+serve a SQL query end to end — the role docker-compose integration
+plays for the reference (dev/integration-tests.sh), without docker."""
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ballista_tpu import schema, Int64, Utf8
+
+
+def _spawn(args, env):
+    return subprocess.Popen(
+        [sys.executable, "-m"] + args, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def test_binaries_end_to_end(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = []
+    try:
+        sched = _spawn(["ballista_tpu.distributed.scheduler_main",
+                        "--bind-host", "localhost", "--port", "0"], env)
+        procs.append(sched)
+        line = sched.stdout.readline()
+        m = re.search(r"listening on [^:]+:(\d+)", line)
+        assert m, f"no port in scheduler output: {line!r}"
+        port = int(m.group(1))
+
+        for i in range(2):
+            e = _spawn(["ballista_tpu.distributed.executor_main",
+                        "--scheduler-host", "localhost",
+                        "--scheduler-port", str(port),
+                        "--work-dir", str(tmp_path / f"w{i}"),
+                        "--num-devices", "1"], env)
+            procs.append(e)
+            out = e.stdout.readline()
+            assert "polling" in out, out
+
+        data = tmp_path / "t.tbl"
+        data.write_text("".join(f"{i}|k{i % 3}|\n" for i in range(90)))
+
+        from ballista_tpu.client import BallistaContext
+        from ballista_tpu.io import TblSource
+
+        ctx = BallistaContext.remote("localhost", port)
+        ctx.register_source(
+            "t", TblSource(str(data), schema(("a", Int64), ("c", Utf8)))
+        )
+        got = ctx.sql(
+            "select c, sum(a) as s, count(*) as n from t group by c order by c"
+        ).collect()
+        a = np.arange(90)
+        for i in range(3):
+            m_ = a % 3 == i
+            assert got["c"][i] == f"k{i}"
+            assert int(got["s"][i]) == int(a[m_].sum())
+            assert int(got["n"][i]) == int(m_.sum())
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
