@@ -54,7 +54,8 @@ def _fuse_mesh_stages(stages, settings):
     hash-shuffle stage."""
     from ..physical import operators as ops
     from ..physical.aggregate import HashAggregateExec
-    from ..physical.mesh_agg import MeshAggExec
+    from ..physical.join import JoinExec
+    from ..physical.mesh_agg import MeshAggExec, MeshJoinExec
     from ..physical.shuffle import QueryStageExec, UnresolvedShuffleExec
 
     try:
@@ -63,16 +64,21 @@ def _fuse_mesh_stages(stages, settings):
         n_mesh = 0
     if n_mesh < 2:
         return stages
+    from collections import Counter
+
+    # by_id is kept UP TO DATE with rewritten stages, so a consumer
+    # fusing later absorbs the fused producer subtree (chained joins),
+    # never a stale child with dangling references to dropped stages
     by_id = {s.stage_id: s for s in stages}
+    refcount = Counter(
+        sid
+        for s in stages
+        for u in find_unresolved_shuffles(s.child)
+        for sid in u.query_stage_ids
+    )
     fused = []
     dropped = set()
     for stage in stages:
-        if stage.shuffle_output_partitions:
-            # this stage is itself a hash-shuffle producer (e.g. an
-            # aggregated subquery feeding a partitioned join); fusing it
-            # would drop its shuffle spec and break downstream readers
-            fused.append(stage)
-            continue
         # walk through single-child vertical wrappers (output projection,
         # HAVING filter) to the final aggregate
         wrappers = []
@@ -80,38 +86,88 @@ def _fuse_mesh_stages(stages, settings):
         while isinstance(plan, (ops.ProjectionExec, ops.FilterExec)):
             wrappers.append(plan)
             plan = plan.children()[0]
-        if not (isinstance(plan, HashAggregateExec) and plan.mode == "final"):
+        def _shuffle_producer(node):
+            """The single hash-shuffle producer stage behind an
+            UnresolvedShuffleExec (referenced nowhere else, so dropping
+            it is safe), or None."""
+            if not (isinstance(node, UnresolvedShuffleExec)
+                    and len(node.query_stage_ids) == 1):
+                return None
+            sid = node.query_stage_ids[0]
+            prod = by_id.get(sid)
+            if prod is None or sid in dropped or refcount[sid] != 1 \
+                    or not prod.shuffle_output_partitions \
+                    or not prod.shuffle_hash_exprs:
+                return None
+            return prod
+
+        new_plan = None
+        if isinstance(plan, HashAggregateExec) and plan.mode == "final":
+            producer = _shuffle_producer(plan.child)
+            if producer is not None:
+                dropped.add(producer.stage_id)
+                new_plan = MeshAggExec(
+                    producer.child, plan.group_exprs, plan.agg_exprs,
+                    list(producer.shuffle_hash_exprs), n_mesh,
+                    plan.group_capacity,
+                )
+                log.info("fused stages %d+%d into a %d-device mesh "
+                         "shuffle-agg", producer.stage_id, stage.stage_id,
+                         n_mesh)
+        else:
+            # partitioned-join fusion: the JoinExec may sit anywhere in
+            # the stage plan (e.g. under a partial aggregate) — replace
+            # the subtree; everything above it runs on host over the
+            # fused single-partition output
+            def replace_join(node):
+                if (isinstance(node, JoinExec) and node.partitioned
+                        and node.how == "inner"):
+                    bprod = _shuffle_producer(node.build)
+                    pprod = _shuffle_producer(node.probe)
+                    if bprod is not None and pprod is not None:
+                        dropped.update({bprod.stage_id, pprod.stage_id})
+                        log.info(
+                            "fused stages %d+%d+%d into a %d-device mesh "
+                            "shuffle-join", bprod.stage_id, pprod.stage_id,
+                            stage.stage_id, n_mesh)
+                        return MeshJoinExec(bprod.child, pprod.child,
+                                            node.on, "inner", n_mesh)
+                kids = node.children()
+                if not kids:
+                    return node
+                new_kids = [replace_join(c) for c in kids]
+                if all(a is b for a, b in zip(kids, new_kids)):
+                    return node
+                return node.with_new_children(new_kids)
+
+            replaced = replace_join(plan)
+            if replaced is not plan:
+                new_plan = replaced
+        if new_plan is None:
             fused.append(stage)
             continue
-        u = plan.child
-        if not (isinstance(u, UnresolvedShuffleExec)
-                and len(u.query_stage_ids) == 1):
-            fused.append(stage)
-            continue
-        producer = by_id.get(u.query_stage_ids[0])
-        if producer is None or not producer.shuffle_output_partitions \
-                or not producer.shuffle_hash_exprs:
-            fused.append(stage)
-            continue
-        dropped.add(producer.stage_id)
-        new_plan = MeshAggExec(
-            producer.child, plan.group_exprs, plan.agg_exprs,
-            list(producer.shuffle_hash_exprs), n_mesh, plan.group_capacity,
-        )
         for w in reversed(wrappers):
             new_plan = w.with_new_children([new_plan])
-        fused.append(QueryStageExec(stage.job_id, stage.stage_id, new_plan))
-        log.info("fused stages %d+%d into a %d-device mesh shuffle-agg",
-                 producer.stage_id, stage.stage_id, n_mesh)
+        # PRESERVE the stage's own shuffle spec: a fused stage may itself
+        # feed a downstream shuffle (e.g. one partitioned join in a chain
+        # of them) — its single task then hash-splits its output as usual
+        rebuilt = QueryStageExec(
+            stage.job_id, stage.stage_id, new_plan,
+            shuffle_hash_exprs=stage.shuffle_hash_exprs,
+            shuffle_output_partitions=stage.shuffle_output_partitions,
+        )
+        by_id[stage.stage_id] = rebuilt
+        fused.append(rebuilt)
     return [s for s in fused if s.stage_id not in dropped]
 
 
 def _mesh_requirement(plan) -> int:
-    """Devices a task of this stage needs (max over MeshAggExec nodes;
+    """Devices a task of this stage needs (max over mesh-fused nodes;
     0 = any executor). Drives device-aware task assignment."""
-    from ..physical.mesh_agg import MeshAggExec
+    from ..physical.mesh_agg import MeshAggExec, MeshJoinExec
 
-    need = plan.n_devices if isinstance(plan, MeshAggExec) else 0
+    need = (plan.n_devices
+            if isinstance(plan, (MeshAggExec, MeshJoinExec)) else 0)
     for c in plan.children():
         need = max(need, _mesh_requirement(c))
     return need

@@ -41,6 +41,73 @@ from .aggregate import DEFAULT_GROUP_CAPACITY, HashAggregateExec
 from .base import PhysicalPlan, Partitioning, concat_batches
 
 
+
+
+def _run_producer_over_mesh(producer: PhysicalPlan, schema: Schema,
+                            n_devices: int):
+    """Run a producer plan on host and lay its live rows out round-robin
+    over the mesh slots (uniform capacity, materialized validity so every
+    slot shares one pytree structure). Returns (device_batches, big)."""
+    batches = []
+    for p in range(producer.output_partitioning().num_partitions):
+        batches.extend(producer.execute(p))
+    if not batches:
+        from ..columnar import empty_batch
+
+        batches = [empty_batch(schema)]
+    big = concat_batches(schema, batches)  # unifies dictionaries
+    sel = np.asarray(big.selection)
+    rows = np.flatnonzero(sel)
+    chunks = np.array_split(rows, n_devices)
+    cap = round_capacity(max((len(c) for c in chunks), default=1) or 1)
+    out = []
+    for c in chunks:
+        cols = []
+        for col in big.columns:
+            vals = np.zeros((cap,), np.asarray(col.values).dtype)
+            vals[: len(c)] = np.asarray(col.values)[c]
+            valid = np.zeros((cap,), bool)
+            if col.validity is not None:
+                valid[: len(c)] = np.asarray(col.validity)[c]
+            else:
+                valid[: len(c)] = True
+            cols.append(Column(jnp.asarray(vals), col.dtype,
+                               jnp.asarray(valid), col.dictionary))
+        live = np.zeros((cap,), bool)
+        live[: len(c)] = True
+        out.append(ColumnBatch(
+            schema, cols, jnp.asarray(live), jnp.asarray(np.int32(len(c))),
+        ))
+    return out, big
+
+
+def _stack_device_batches(device_batches):
+    return jax.tree.map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+        *device_batches,
+    )
+
+
+def _shuffle_side(b: ColumnBatch, hash_exprs, ev: Evaluator, n_dev: int,
+                  in_cap: int, axis: str = "data") -> ColumnBatch:
+    """Traced: hash rows by ``hash_exprs`` and exchange them over the
+    mesh axis; returns the post-shuffle per-device batch (capacity
+    n_dev * in_cap)."""
+    dest = _partition_ids(b, hash_exprs, n_dev, ev)
+    arrays = [c.values for c in b.columns] + [c.validity for c in b.columns]
+    out_arrays, out_live, _counts = mesh_shuffle.all_to_all_rows(
+        arrays, b.selection, dest, axis, n_dev, dest_capacity=in_cap,
+    )
+    nf = len(b.schema.fields)
+    cols = [
+        Column(v, f.dtype, va, c.dictionary)
+        for v, va, f, c in zip(out_arrays[:nf], out_arrays[nf:],
+                               b.schema.fields, b.columns)
+    ]
+    return ColumnBatch(b.schema, cols, out_live,
+                       jnp.sum(out_live).astype(jnp.int32))
+
+
 class _SchemaOnly(PhysicalPlan):
     """Placeholder child that only carries a schema (the mesh runner
     feeds batches directly, there is nothing to execute)."""
@@ -105,41 +172,9 @@ class MeshAggExec(PhysicalPlan):
     # -- execution -----------------------------------------------------------
 
     def _device_batches(self) -> List[ColumnBatch]:
-        """Run the producer on host and lay its live rows out round-robin
-        over the mesh slots (uniform capacity, materialized validity so
-        every slot shares one pytree structure)."""
-        batches = []
-        for p in range(self.producer.output_partitioning().num_partitions):
-            batches.extend(self.producer.execute(p))
-        if not batches:
-            from ..columnar import empty_batch
-
-            batches = [empty_batch(self._partial_schema)]
-        big = concat_batches(self._partial_schema, batches)  # unifies dicts
-        sel = np.asarray(big.selection)
-        rows = np.flatnonzero(sel)
-        chunks = np.array_split(rows, self.n_devices)
-        cap = round_capacity(max((len(c) for c in chunks), default=1) or 1)
-        out = []
-        for c in chunks:
-            cols = []
-            for col in big.columns:
-                vals = np.zeros((cap,), np.asarray(col.values).dtype)
-                vals[: len(c)] = np.asarray(col.values)[c]
-                if col.validity is not None:
-                    valid = np.zeros((cap,), bool)
-                    valid[: len(c)] = np.asarray(col.validity)[c]
-                else:
-                    valid = np.zeros((cap,), bool)
-                    valid[: len(c)] = True
-                cols.append(Column(jnp.asarray(vals), col.dtype,
-                                   jnp.asarray(valid), col.dictionary))
-            live = np.zeros((cap,), bool)
-            live[: len(c)] = True
-            out.append(ColumnBatch(
-                self._partial_schema, cols, jnp.asarray(live),
-                jnp.asarray(np.int32(len(c))),
-            ))
+        out, _big = _run_producer_over_mesh(self.producer,
+                                            self._partial_schema,
+                                            self.n_devices)
         return out
 
     def _spmd(self, stacked, mesh, cap: int, in_cap: int):
@@ -149,30 +184,14 @@ class MeshAggExec(PhysicalPlan):
         from ..parallel.mesh import shard_map  # version-guarded import
 
         n_dev = self.n_devices
-        fields = self._partial_schema.fields
+
         final_fn = self._final._get_grouped_fn(cap, n_dev * in_cap)
 
         @partial(shard_map, mesh=mesh, in_specs=(P("data"),),
                  out_specs=(P("data"), P("data")), check_vma=False)
         def run(stacked_b):
             b = jax.tree.map(lambda x: x[0], stacked_b)
-            dest = _partition_ids(b, self.hash_exprs, n_dev, self._ev)
-            arrays = [c.values for c in b.columns] + \
-                     [c.validity for c in b.columns]
-            out_arrays, out_live, _counts = mesh_shuffle.all_to_all_rows(
-                arrays, b.selection, dest, "data", n_dev,
-                dest_capacity=in_cap,
-            )
-            vals = out_arrays[: len(fields)]
-            valids = out_arrays[len(fields):]
-            cols = [
-                Column(v, f.dtype, va, c.dictionary)
-                for v, va, f, c in zip(vals, valids, fields, b.columns)
-            ]
-            b2 = ColumnBatch(
-                self._partial_schema, cols, out_live,
-                jnp.sum(out_live).astype(jnp.int32),
-            )
+            b2 = _shuffle_side(b, self.hash_exprs, self._ev, n_dev, in_cap)
             out_batch, num_groups = final_fn(b2)
             return (
                 jax.tree.map(lambda x: x[None], out_batch),
@@ -187,10 +206,7 @@ class MeshAggExec(PhysicalPlan):
         mesh = make_mesh(self.n_devices)
         device_batches = self._device_batches()
         in_cap = device_batches[0].capacity
-        stacked = jax.tree.map(
-            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
-            *device_batches,
-        )
+        stacked = _stack_device_batches(device_batches)
         sharding = NamedSharding(mesh, P("data"))
         stacked = jax.device_put(stacked, sharding)
         cap = self.group_capacity
@@ -210,3 +226,145 @@ def _partition_ids(batch: ColumnBatch, hash_exprs, n_dev: int,
     from .operators import compute_partition_ids
 
     return compute_partition_ids(batch, hash_exprs, n_dev, 0, ev)
+
+class MeshJoinExec(PhysicalPlan):
+    """Mesh-fused co-partitioned INNER join: BOTH join inputs are
+    exchanged over ICI ``lax.all_to_all`` (hashed on the join keys) and
+    joined per device in the same SPMD program — BASELINE config 4's
+    shape ("q5 shuffle -> ICI all_to_all") with zero shuffle files.
+
+    Built by the scheduler's fusion pass from a partitioned JoinExec
+    stage and its two hash-shuffle producer stages. v1 scope: inner
+    joins (outer/semi/anti keep the host path). Key representation is
+    raw values for one key column, the exact rank codec otherwise —
+    decided statically, no host-side range checks. Output: a single
+    partition containing every device's joined rows (adaptive output
+    capacity with whole-SPMD retry on overflow, like MeshAggExec).
+    """
+
+    def __init__(self, build_producer: PhysicalPlan,
+                 probe_producer: PhysicalPlan, on, how: str,
+                 n_devices: int):
+        if how != "inner":
+            raise ExecutionError("MeshJoinExec supports inner joins only")
+        self.build_producer = build_producer
+        self.probe_producer = probe_producer
+        self.on = list(on)
+        self.how = how
+        self.n_devices = n_devices
+        from .join import JoinExec
+
+        # schema/key helpers only; never executed
+        self._join = JoinExec(
+            _SchemaOnly(build_producer.output_schema()),
+            _SchemaOnly(probe_producer.output_schema()),
+            self.on, how,
+        )
+        self._build_ev = Evaluator(build_producer.output_schema())
+        self._probe_ev = Evaluator(probe_producer.output_schema())
+
+    # -- plan plumbing -------------------------------------------------------
+
+    def output_schema(self) -> Schema:
+        return self._join.output_schema()
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning("unknown", 1)
+
+    def children(self):
+        return [self.build_producer, self.probe_producer]
+
+    def with_new_children(self, children):
+        return MeshJoinExec(children[0], children[1], self.on, self.how,
+                            self.n_devices)
+
+    def display(self) -> str:
+        on = ", ".join(f"{l}={r}" for l, r in self.on)
+        return (f"MeshJoinExec: {self.n_devices}-device ICI all_to_all "
+                f"join how={self.how} on=[{on}]")
+
+    # -- execution -----------------------------------------------------------
+
+    def _spmd(self, stacked_b, stacked_p, mesh, remaps, out_cap: int,
+              b_cap: int, p_cap: int):
+        from functools import partial as fpartial
+
+        from ..kernels import join as join_k
+        from ..parallel.mesh import shard_map
+
+        n_dev = self.n_devices
+        bcols = [b for b, _ in self.on]
+        pcols = [p for _, p in self.on]
+        bhash = [ex.ColumnRef(c) for c in bcols]
+        phash = [ex.ColumnRef(c) for c in pcols]
+        out_schema = self.output_schema()
+        probe_schema = self.probe_producer.output_schema()
+
+        @fpartial(shard_map, mesh=mesh, in_specs=(P("data"), P("data")),
+                  out_specs=(P("data"), P("data")), check_vma=False)
+        def run(sb, sp):
+            b = jax.tree.map(lambda x: x[0], sb)
+            p = jax.tree.map(lambda x: x[0], sp)
+            b2 = _shuffle_side(b, bhash, self._build_ev, n_dev, b_cap)
+            p2 = _shuffle_side(p, phash, self._probe_ev, n_dev, p_cap)
+            # keys: raw for a single column, exact rank codec otherwise
+            if len(self.on) == 1:
+                bk = b2.column(bcols[0]).values.astype(jnp.int64)
+                blive = b2.selection
+                v = b2.column(bcols[0]).validity
+                if v is not None:
+                    blive = jnp.logical_and(blive, v)
+                pk, pvalid = self._join._probe_col_values(
+                    p2, pcols[0], remaps[0])
+                plive = p2.selection
+                if pvalid is not None:
+                    plive = jnp.logical_and(plive, pvalid)
+            else:
+                bk, blive, (tables, nlive) = self._join._codec_build(
+                    b2, bcols)
+                pk, plive = self._join._probe_keys(p2, "codec",
+                                                   (tables, nlive), remaps)
+            table = join_k.build_lookup(bk, blive)
+            prows, brows, olive, total = join_k.probe_expand(
+                table, pk, plive, out_cap)
+            cols = []
+            for f in out_schema.fields:
+                src = p2 if probe_schema.has_field(f.name) else b2
+                rows = prows if probe_schema.has_field(f.name) else brows
+                c = src.column(f.name)
+                vals = jnp.take(c.values, rows)
+                validity = (jnp.take(c.validity, rows)
+                            if c.validity is not None else None)
+                cols.append(Column(vals, f.dtype, validity, c.dictionary))
+            out = ColumnBatch(out_schema, cols, olive,
+                              jnp.sum(olive).astype(jnp.int32))
+            return jax.tree.map(lambda x: x[None], out), total[None]
+
+        return run(stacked_b, stacked_p)
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        if partition != 0:
+            raise ExecutionError("MeshJoinExec has a single output partition")
+        mesh = make_mesh(self.n_devices)
+        bdev, bbig = _run_producer_over_mesh(
+            self.build_producer, self.build_producer.output_schema(),
+            self.n_devices)
+        pdev, pbig = _run_producer_over_mesh(
+            self.probe_producer, self.probe_producer.output_schema(),
+            self.n_devices)
+        remaps = self._join._remaps_for(bbig, pbig)
+        sharding = NamedSharding(mesh, P("data"))
+        sb = jax.device_put(_stack_device_batches(bdev), sharding)
+        sp = jax.device_put(_stack_device_batches(pdev), sharding)
+        b_cap, p_cap = bdev[0].capacity, pdev[0].capacity
+        out_cap = self.n_devices * p_cap  # post-shuffle probe rows/device
+        while True:
+            out_stacked, totals = self._spmd(sb, sp, mesh, remaps, out_cap,
+                                             b_cap, p_cap)
+            t = int(np.max(np.asarray(totals)))
+            if t <= out_cap:
+                break
+            out_cap = round_capacity(t)  # duplicate-heavy keys: retry
+        for q in range(self.n_devices):
+            yield jax.tree.map(lambda x, _q=q: jnp.asarray(x)[_q],
+                               out_stacked)

@@ -351,7 +351,7 @@ def plan_from_proto(n: pb.LogicalPlanNode) -> lp.LogicalPlan:
 def physical_to_proto(plan) -> pb.PhysicalPlanNode:
     from .physical.aggregate import HashAggregateExec
     from .physical.join import JoinExec
-    from .physical.mesh_agg import MeshAggExec
+    from .physical.mesh_agg import MeshAggExec, MeshJoinExec
     from .physical import operators as ops
     from .physical.shuffle import ShuffleReaderExec, UnresolvedShuffleExec
 
@@ -387,6 +387,17 @@ def physical_to_proto(plan) -> pb.PhysicalPlanNode:
         n.join.how = plan.how
         n.join.null_aware = plan.null_aware
         n.join.partitioned = plan.partitioned
+    elif isinstance(plan, MeshJoinExec):
+        n.mesh_join.build_producer.CopyFrom(
+            physical_to_proto(plan.build_producer))
+        n.mesh_join.probe_producer.CopyFrom(
+            physical_to_proto(plan.probe_producer))
+        for l, r in plan.on:
+            o = n.mesh_join.on.add()
+            o.left_col = l
+            o.right_col = r
+        n.mesh_join.how = plan.how
+        n.mesh_join.n_devices = plan.n_devices
     elif isinstance(plan, MeshAggExec):
         n.mesh_agg.producer.CopyFrom(physical_to_proto(plan.producer))
         for e in plan.group_exprs:
@@ -465,6 +476,16 @@ def physical_from_proto(n: pb.PhysicalPlanNode):
             n.join.how,
             null_aware=n.join.null_aware,
             partitioned=n.join.partitioned,
+        )
+    if kind == "mesh_join":
+        from .physical.mesh_agg import MeshJoinExec as _MeshJoinExec
+
+        return _MeshJoinExec(
+            physical_from_proto(n.mesh_join.build_producer),
+            physical_from_proto(n.mesh_join.probe_producer),
+            [(o.left_col, o.right_col) for o in n.mesh_join.on],
+            n.mesh_join.how,
+            n.mesh_join.n_devices,
         )
     if kind == "mesh_agg":
         from .physical.aggregate import DEFAULT_GROUP_CAPACITY

@@ -125,6 +125,61 @@ def test_cluster_mesh_shuffle_agg(eight_devices, tmp_path):
         cluster.shutdown()
 
 
+def test_cluster_mesh_shuffle_join(eight_devices, tmp_path):
+    """A partitioned inner join under mesh.devices fuses into ONE
+    MeshJoinExec task: both sides exchanged over lax.all_to_all, joined
+    per device, ZERO shuffle files — BASELINE config 4's q5 shape."""
+    from ballista_tpu import Decimal
+
+    d = tmp_path / "dim"
+    d.mkdir()
+    (d / "p0.tbl").write_text("".join(f"{i}|cat{i % 3}|\n" for i in range(11)))
+    f = tmp_path / "fact"
+    f.mkdir()
+    for part in range(2):
+        rows = [f"{i}|{i % 11}|{i + 0.5:.2f}|\n"
+                for i in range(120) if i % 2 == part]
+        (f / f"p{part}.tbl").write_text("".join(rows))
+    from ballista_tpu.io import TblSource
+
+    dim_s = schema(("dkey", Int64), ("cat", Utf8))
+    fact_s = schema(("fid", Int64), ("fkey", Int64), ("v", Decimal(2)))
+    cluster = LocalCluster(num_executors=1, concurrent_tasks=2,
+                          num_devices=8)
+    try:
+        ctx = BallistaContext.remote(
+            "localhost", cluster.port,
+            **{"join.partitioned.threshold": "1", "join.partitions": "8",
+               "mesh.devices": "8"},
+        )
+        ctx.register_source("dim", TblSource(str(d), dim_s),
+                            primary_key="dkey")
+        ctx.register_source("fact", TblSource(str(f), fact_s))
+        got = ctx.sql(
+            "select cat, sum(v) as sv, count(*) as n from fact, dim "
+            "where fkey = dkey group by cat order by cat"
+        ).collect()
+
+        a = np.arange(120)
+        fd = pd.DataFrame({"fkey": a % 11, "v": a + 0.5})
+        fd["cat"] = fd.fkey.map(lambda k: f"cat{k % 3}")
+        exp = fd.groupby("cat").agg(sv=("v", "sum"), n=("v", "size")) \
+            .reset_index().sort_values("cat")
+        np.testing.assert_array_equal(got["cat"], exp["cat"])
+        np.testing.assert_allclose(got["sv"], exp["sv"], rtol=1e-9)
+        np.testing.assert_array_equal(got["n"].astype(np.int64),
+                                      exp["n"].astype(np.int64))
+
+        shuffle_files = []
+        for e in cluster.executors:
+            for root, _, files in os.walk(e.config.work_dir):
+                shuffle_files += [x for x in files
+                                  if x.startswith("shuffle-")]
+        assert shuffle_files == [], f"host shuffle files written: {shuffle_files}"
+    finally:
+        cluster.shutdown()
+
+
 def test_cluster_file_shuffle_without_mesh_setting(eight_devices, tmp_path):
     """Same query WITHOUT mesh.devices: the host-file shuffle runs (and
     still matches), proving the fusion is what removed the files above."""
