@@ -79,6 +79,27 @@ def test_raw_sql_create_external_table_rejected(tmp_path):
     assert "client-side" in (st.error or "")
 
 
+def test_get_file_metadata_parquet(tmp_path):
+    """(reference parity: GetFileMetadata is Parquet-only schema/partition
+    discovery, rust/scheduler/src/lib.rs:184-222)"""
+    import pandas as pd
+
+    p = tmp_path / "t"
+    p.mkdir()
+    pd.DataFrame({"a": [1, 2], "b": ["x", "y"]}).to_parquet(
+        p / "part-0.parquet")
+    pd.DataFrame({"a": [3], "b": ["z"]}).to_parquet(p / "part-1.parquet")
+
+    svc = SchedulerService(SchedulerState(MemoryBackend()))
+    res = svc.GetFileMetadata(pb.GetFileMetadataParams(
+        path=str(p), file_type="parquet"))
+    assert [f.name for f in res.schema.fields] == ["a", "b"]
+    assert res.num_partitions == 2
+    with pytest.raises(ClusterError, match="Parquet"):
+        svc.GetFileMetadata(pb.GetFileMetadataParams(path=str(p),
+                                                     file_type="csv"))
+
+
 def test_raw_sql_frame_supports_dataframe_api(tmp_path):
     """A server-planned frame still answers schema()/count() by planning
     locally on demand, and DDL registers client-side under plan.server."""
