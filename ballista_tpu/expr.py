@@ -60,6 +60,12 @@ class Expr:
     def cast(self, dtype: DataType) -> "Expr":
         return Cast(self, dtype)
 
+    def asc(self, nulls_first: bool = False) -> "SortExpr":
+        return SortExpr(self, True, nulls_first)
+
+    def desc(self, nulls_first: bool = False) -> "SortExpr":
+        return SortExpr(self, False, nulls_first)
+
     def is_null(self) -> "Expr":
         return IsNull(self)
 
