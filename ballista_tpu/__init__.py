@@ -48,7 +48,7 @@ if _cache_dir:
     except (OSError, AttributeError):  # unwritable dir / older jax
         pass
 
-BALLISTA_TPU_VERSION = "0.1.0"
+BALLISTA_TPU_VERSION = "0.2.0"
 
 from .datatypes import (  # noqa: E402
     Boolean,

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Bump BALLISTA_TPU_VERSION (reference parity: dev/bump-version.sh seds
+# across manifests).
+set -euo pipefail
+[[ $# == 1 ]] || { echo "usage: $0 <new-version>" >&2; exit 2; }
+cd "$(dirname "$0")/.."
+sed -i "s/^BALLISTA_TPU_VERSION = \".*\"/BALLISTA_TPU_VERSION = \"$1\"/" \
+    ballista_tpu/__init__.py
+grep -n "BALLISTA_TPU_VERSION" ballista_tpu/__init__.py

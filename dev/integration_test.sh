@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Integration gate (reference parity: dev/integration-tests.sh builds
+# images, generates data, runs the compose cluster + query subset; here:
+# native build, fast suite incl. the process-level binary cluster test,
+# then the benchmark smoke). Opt into the SF0.2 scale suite with
+#   RUN_SF02=1 dev/integration_test.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+make -C ballista_tpu/native
+python -m pytest tests/ -q
+if [[ "${RUN_SF02:-0}" == "1" ]]; then
+  python -m pytest tests/test_tpch_sf02.py -m sf02 -q
+fi
+python bench.py --cpu --scale 0.2 --runs 2
