@@ -182,6 +182,37 @@ def concat_batches(schema: Schema, batches: List[ColumnBatch]) -> ColumnBatch:
     return ColumnBatch(schema, cols, selection, num_rows)
 
 
+_COMPACT_JITS: dict = {}
+
+
+def maybe_compact(batch: ColumnBatch, shrink_factor: int = 4) -> ColumnBatch:
+    """Shrink a sparse batch: when live rows fill under 1/shrink_factor
+    of the capacity, gather them to the front of a smaller batch. One
+    sort+gather now buys every downstream operator a smaller shape —
+    decisive after selective joins/filters in long pipelines. Costs a
+    host sync on the live count; callers use it at operator boundaries
+    where a sync is already imminent."""
+    from ..columnar import round_capacity
+
+    n = int(batch.num_rows)
+    cap = batch.capacity
+    new_cap = max(round_capacity(n), 8)
+    if new_cap * shrink_factor > cap:
+        return batch
+    key = (cap, new_cap)
+    if key not in _COMPACT_JITS:
+
+        def compact(b: ColumnBatch, _new=new_cap) -> ColumnBatch:
+            dead = jnp.logical_not(b.selection)
+            idx = jnp.arange(b.capacity, dtype=jnp.int32)
+            _, perm = jax.lax.sort((dead, idx), num_keys=1, is_stable=True)
+            live = jnp.arange(_new, dtype=jnp.int32) < b.num_rows
+            return take_batch(b, perm[:_new], live)
+
+        _COMPACT_JITS[key] = jax.jit(compact)
+    return _COMPACT_JITS[key](batch)
+
+
 def take_batch(batch: ColumnBatch, perm: jax.Array, live: jax.Array) -> ColumnBatch:
     """Reorder a batch by ``perm``; ``live`` is the selection after reorder."""
     cols = []

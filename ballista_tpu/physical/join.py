@@ -274,11 +274,15 @@ class JoinExec(PhysicalPlan):
                     jnp.zeros((pb.capacity,), jnp.bool_)
                 )
             return
+        from .base import maybe_compact
+
         for pb in self.probe.execute(partition):
             remaps = self._remaps_for(build_batch, pb)
             if unique:
-                yield self._probe_unique_batch(table, build_batch, pb,
-                                               mode, key_tables, remaps)
+                # selective joins strand few live rows in huge batches;
+                # compacting here shrinks every downstream operator
+                yield maybe_compact(self._probe_unique_batch(
+                    table, build_batch, pb, mode, key_tables, remaps))
             else:
                 yield from self._probe_expand_batch(table, build_batch, pb,
                                                     mode, key_tables, remaps)
