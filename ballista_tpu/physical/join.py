@@ -494,7 +494,9 @@ class JoinExec(PhysicalPlan):
             if t <= out_cap:
                 break
             out_cap = round_capacity(t)
-        yield out
+        from .base import maybe_compact
+
+        yield maybe_compact(out)
         if self.how in ("left", "full"):
             # preserved probe rows with no match, null build columns
             key = ("l", mode, pb.capacity, build_batch.capacity)
