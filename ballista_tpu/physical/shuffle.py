@@ -100,6 +100,10 @@ class ShuffleReaderExec(PhysicalPlan):
       partition q reads the shuffle-q file of EVERY producer partition.
     """
 
+    # tests flip this to exercise the cross-host (socket) path even when
+    # producer and consumer share a filesystem
+    FORCE_REMOTE = False
+
     def __init__(self, partition_locations: List[PartitionLocation],
                  schema: Schema):
         self.partition_locations = list(partition_locations)
@@ -137,7 +141,7 @@ class ShuffleReaderExec(PhysicalPlan):
 
         parts = []
         for loc in self._groups[q]:
-            if loc.path and os.path.exists(loc.path):
+            if not self.FORCE_REMOTE and loc.path and os.path.exists(loc.path):
                 _, arrays, nulls, dicts, _ = ipc.read_partition_arrays(loc.path)
             else:
                 buf = self._fetch_with_retry(loc)

@@ -252,6 +252,32 @@ def test_cluster_join_query(cluster, tmp_path):
     np.testing.assert_allclose(got["sv"], exp["v"], rtol=1e-9)
 
 
+def test_cluster_shuffle_over_sockets_only(cluster, tmp_path, monkeypatch):
+    """Force every shuffle fetch over the data-plane socket (the
+    cross-host path): LocalCluster executors share a filesystem, so the
+    local-path shortcut would otherwise hide the remote protocol."""
+    from ballista_tpu.physical.shuffle import ShuffleReaderExec
+
+    monkeypatch.setattr(ShuffleReaderExec, "FORCE_REMOTE", True)
+    src = _mem_table(tmp_path)
+    from ballista_tpu.client import BallistaContext
+
+    ctx = BallistaContext.remote("localhost", cluster.port)
+    ctx.register_source("t", src)
+    got = ctx.sql(
+        "select c, sum(b) as s from t group by c order by c"
+    ).collect()
+    import pandas as pd
+
+    a = np.arange(100)
+    exp = (
+        pd.DataFrame({"c": [f"k{i % 3}" for i in a], "b": (a % 7) + 0.25})
+        .groupby("c").agg(s=("b", "sum")).reset_index().sort_values("c")
+    )
+    np.testing.assert_array_equal(got["c"], exp["c"])
+    np.testing.assert_allclose(got["s"], exp["s"], rtol=1e-9)
+
+
 def test_cluster_hash_repartition_shuffle(cluster, tmp_path):
     """Distributed hash shuffle: a Repartition stage writes one shuffle-q
     file per consumer partition; consumers read the q-files of every
