@@ -187,6 +187,27 @@ def main() -> None:
     if q5_warm is not None:
         result["q5_warm_seconds"] = round(q5_warm, 4)
         result["q5_rows_per_sec"] = round(total_rows / q5_warm, 1)
+
+    # -- Pallas A/B on real accelerators ------------------------------------
+    # q1's dense aggregation has a fused Pallas kernel (kernels/
+    # pallas_agg.py); on a chip, re-run q1 with it enabled so the
+    # XLA-vs-Pallas delta is recorded automatically. A FRESH context is
+    # required: operator jit caches bake the path chosen at trace time.
+    if platform != "cpu":
+        try:
+            os.environ["BALLISTA_PALLAS"] = "on"
+            ctx_p = BallistaContext.standalone()
+            register_tpch(ctx_p, data_dir, "tbl", cached=True)
+            dfp = ctx_p.sql(sql)
+            dfp.collect()  # load + compile with the Pallas path
+            q1_pallas = min(timed(dfp) for _ in range(args.runs))
+            result["q1_pallas_warm_seconds"] = round(q1_pallas, 4)
+            result["q1_pallas_rows_per_sec"] = round(total_rows / q1_pallas, 1)
+        except Exception as e:  # noqa: BLE001 - A/B is best-effort
+            print(f"# pallas q1 failed: {e}", file=sys.stderr)
+            result["q1_pallas_error"] = str(e)[:200]
+        finally:
+            os.environ.pop("BALLISTA_PALLAS", None)
     print(json.dumps(result))
 
 
