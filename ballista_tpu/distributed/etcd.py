@@ -94,21 +94,24 @@ class EtcdBackend(KvBackend):
         return [(kv.key.decode(), kv.value) for kv in resp.kvs]
 
     def put(self, key: str, value: bytes, lease_secs: Optional[int] = None):
-        lease_id = 0
-        if lease_secs:
-            # etcd lease TTLs are fixed at grant time (extending needs the
-            # streaming KeepAlive RPC), so each leased write re-grants;
-            # the key's PREVIOUS lease is revoked to avoid accumulation
-            # (safe: the key is re-attached to the new lease first)
+        if not lease_secs:
+            self._put(epb.PutRequest(key=key.encode(), value=value))
+            return
+        # etcd lease TTLs are fixed at grant time (extending needs the
+        # streaming KeepAlive RPC), so each leased write re-grants and
+        # revokes the key's PREVIOUS lease to avoid accumulation. The
+        # whole grant+put+record+revoke sequence is serialized: two
+        # interleaved heartbeat puts could otherwise record the live
+        # lease as "old" and revoke it, deleting the key and making the
+        # executor look dead until its next heartbeat.
+        with self._key_leases_mu:
             lease_id = self._grant(
                 epb.LeaseGrantRequest(TTL=lease_secs)
             ).ID
-        self._put(epb.PutRequest(key=key.encode(), value=value,
-                                 lease=lease_id))
-        if lease_secs:
-            with self._key_leases_mu:
-                old = self._key_leases.get(key)
-                self._key_leases[key] = lease_id
+            self._put(epb.PutRequest(key=key.encode(), value=value,
+                                     lease=lease_id))
+            old = self._key_leases.get(key)
+            self._key_leases[key] = lease_id
             if old:
                 self._revoke(epb.LeaseRevokeRequest(ID=old))
 

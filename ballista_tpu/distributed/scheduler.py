@@ -290,6 +290,12 @@ class SchedulerService:
             jobs_touched.add(st.partition.job_id)
             if st.state == "completed":
                 self.state.task_completed(st)
+            elif st.state == "failed" and self.state.is_completed(st.partition):
+                # the losing speculative duplicate failed AFTER the
+                # original completed: the recorded result stands — a
+                # failure report must not clobber it or trigger recovery
+                log.info("dropping failure report for already-completed "
+                         "task %s", st.partition.key())
             elif st.state == "failed" and (
                 self.state.recover_fetch_failure(st)
                 or self.state.recover_transient_failure(st)
@@ -306,7 +312,7 @@ class SchedulerService:
             task = self.state.next_task(meta.num_devices)
             if task is None and self.speculation_age_secs > 0:
                 task = self.state.speculative_task(
-                    meta.num_devices, self.speculation_age_secs
+                    meta.num_devices, self.speculation_age_secs, meta.id
                 )
                 if task is not None:
                     log.warning("speculating straggler task %s on executor "
@@ -316,9 +322,13 @@ class SchedulerService:
                     result.task.CopyFrom(self._task_definition(task, meta))
                 except Exception as e:  # noqa: BLE001
                     log.exception("task resolution failed for %s", task)
-                    self.state.save_task_status(
-                        TaskStatus(task, "failed", error=str(e))
-                    )
+                    st = TaskStatus(task, "failed", error=str(e))
+                    # a tagged ShuffleFetchError here means a completed
+                    # producer's data became unreachable (stage_locations
+                    # refused to emit an unroutable address) — re-queue the
+                    # producer instead of failing the consumer
+                    if not self.state.recover_fetch_failure(st):
+                        self.state.save_task_status(st)
                     jobs_touched.add(task.job_id)
         for job_id in jobs_touched:
             self.state.synchronize_job_status(job_id)
@@ -333,7 +343,8 @@ class SchedulerService:
         node.ParseFromString(plan_bytes)
         plan = serde.physical_from_proto(node)
         if deps:
-            locations = self.state.stage_locations(task.job_id)
+            locations = self.state.stage_locations(task.job_id,
+                                                   stages=set(deps))
             # expand hash-shuffled producer locations into per-consumer files
             for dep in deps:
                 _, _, _, dep_spec, _ = self.state.get_stage_plan(task.job_id, dep)

@@ -169,6 +169,11 @@ class SchedulerState:
         self._stage_parts: Dict[Tuple[str, int], int] = {}
         # (job, stage) -> devices a task needs (0 = any)
         self._stage_mesh: Dict[Tuple[str, int], int] = {}
+        # tasks already handed out as speculative duplicates (at most one
+        # duplicate per task), and the last speculation scan time — both
+        # guarded by self._lock
+        self._speculated: set = set()
+        self._last_spec_scan = 0.0
         self._rehydrate()
 
     def _rehydrate(self):
@@ -340,13 +345,28 @@ class SchedulerState:
                 return self._ready.pop(i)
         return None
 
+    def is_completed(self, pid: PartitionId) -> bool:
+        v = self.kv.get(self._k("tasks", pid.job_id, pid.stage_id,
+                                pid.partition_id))
+        return v is not None and pickle.loads(v).state == "completed"
+
     def task_completed(self, st: TaskStatus):
         """Record completion; if a whole stage just completed, unlock its
-        dependents (event-driven, replacing the reference's full scan)."""
-        self.save_task_status(st)
+        dependents (event-driven, replacing the reference's full scan).
+        First result wins: when speculation duplicated the task, the
+        second completion report is dropped so consumers keep fetching
+        from the location already recorded."""
         job_id = st.partition.job_id
         stage_id = st.partition.stage_id
         with self._lock:
+            prior = next(
+                (t for t in self.get_task_statuses(job_id, stage_id)
+                 if t.partition.partition_id == st.partition.partition_id),
+                None,
+            )
+            if prior is not None and prior.state == "completed":
+                return  # a duplicate (speculative) completion lost the race
+            self.save_task_status(st)
             stage_tasks = self.get_task_statuses(job_id, stage_id)
             n = self._stage_parts.get((job_id, stage_id))
             done = [t for t in stage_tasks if t.state == "completed"]
@@ -369,21 +389,38 @@ class SchedulerState:
         ]
         return len(done) >= n
 
-    def stage_locations(self, job_id: str) -> Dict[int, List[PartitionLocation]]:
-        """Completed-task locations per stage (for shuffle resolution)."""
+    def stage_locations(self, job_id: str, stages=None
+                        ) -> Dict[int, List[PartitionLocation]]:
+        """Completed-task locations per stage (for shuffle resolution).
+        `stages` restricts the scan so an unroutable, already-consumed
+        stage elsewhere in the job can't fail an unrelated resolution."""
         out: Dict[int, List[PartitionLocation]] = {}
         executors = {e.id: e for e in self.get_executors_metadata()}
         for t in self.get_task_statuses(job_id):
             if t.state != "completed":
+                continue
+            if stages is not None and t.partition.stage_id not in stages:
                 continue
             e = executors.get(t.executor_id)
             if e is None and t.executor_id:
                 # lease expired: fall back to the durable address record —
                 # the data may still be served; if not, the consumer fails
                 # with a tagged ShuffleFetchError and recovery re-queues
-                # the producer (never hand out host="",port=0)
+                # the producer
                 e = self.executor_address(t.executor_id)
-            host, port = (e.host, e.port) if e else ("", 0)
+            if e is None:
+                # no route to the data at all: fail resolution with the
+                # tagged error NOW so the caller triggers producer
+                # recovery, instead of emitting host="",port=0 for a
+                # consumer to trip over
+                from ..errors import ShuffleFetchError
+
+                raise ShuffleFetchError(
+                    t.partition.stage_id, [t.partition.partition_id],
+                    t.executor_id or "",
+                    "completed task has no routable executor address",
+                )
+            host, port = e.host, e.port
             out.setdefault(t.partition.stage_id, []).append(
                 PartitionLocation(
                     job_id=t.partition.job_id,
@@ -490,15 +527,29 @@ class SchedulerState:
             self._enqueue_stage(st.partition.job_id, st.partition.stage_id)
         return True
 
+    SPECULATION_SCAN_INTERVAL_SECS = 5.0
+
     def speculative_task(self, num_devices: int = 0,
-                         age_secs: float = 60.0) -> Optional[PartitionId]:
+                         age_secs: float = 60.0,
+                         executor_id: str = "",
+                         min_interval_secs: Optional[float] = None
+                         ) -> Optional[PartitionId]:
         """Straggler mitigation the reference lacks entirely: when an
         executor is idle and nothing is ready, hand out a DUPLICATE of a
-        long-running task (first completion wins — stage outputs are
-        per-executor files, so the recorded completion's location is
-        self-consistent). Each task is duplicated at most once."""
+        long-running task (first completion wins — task_completed drops
+        later reports, so the recorded completion's location is
+        self-consistent). Each task is duplicated at most once, never on
+        the executor already running it (a duplicate on the same executor
+        would race the original on the same work_dir path), and fruitless
+        full-task scans are throttled like reap_lost_tasks (a successful
+        scan doesn't delay the next one — only the idle-poll storm with
+        nothing to speculate is capped)."""
+        if min_interval_secs is None:
+            min_interval_secs = self.SPECULATION_SCAN_INTERVAL_SECS
         now = time.time()
-        self._speculated = getattr(self, "_speculated", set())
+        with self._lock:
+            if now - self._last_spec_scan < min_interval_secs:
+                return None
         for k, v in self.kv.get_from_prefix(self._k("jobs")):
             if pickle.loads(v).state not in ("queued", "running"):
                 continue
@@ -508,13 +559,16 @@ class SchedulerState:
                     key = t.partition
                     if (t.state == "running" and t.started_at
                             and now - t.started_at > age_secs
-                            and key not in self._speculated):
+                            and key not in self._speculated
+                            and t.executor_id != executor_id):
                         need = self._stage_mesh.get(
                             (job_id, t.partition.stage_id), 0)
                         if need and num_devices and num_devices < need:
                             continue
                         self._speculated.add(key)
                         return t.partition
+        with self._lock:
+            self._last_spec_scan = now
         return None
 
     def reap_lost_tasks(self, min_interval_secs: float = 5.0) -> List[str]:
@@ -578,7 +632,26 @@ class SchedulerState:
         n = self._stage_parts.get((job_id, final_sid), len(final_tasks))
         done = [t for t in final_tasks if t.state == "completed"]
         if final_tasks and len(done) >= n:
-            locs = self.stage_locations(job_id).get(final_sid, [])
+            from ..errors import ShuffleFetchError
+
+            try:
+                locs = self.stage_locations(
+                    job_id, stages={final_sid}
+                ).get(final_sid, [])
+            except ShuffleFetchError as e:
+                # a completed result partition lost its executor before the
+                # client fetched it — re-queue the producer (within budget)
+                # rather than publishing an unroutable location
+                if not self.recover_fetch_failure(
+                    TaskStatus(
+                        PartitionId(job_id, final_sid, e.partition_ids[0]),
+                        "failed", error=str(e),
+                    )
+                ):
+                    self.save_job_status(
+                        job_id, JobStatus("failed", error=str(e))
+                    )
+                return
             self.save_job_status(
                 job_id, JobStatus("completed", partition_locations=locs)
             )

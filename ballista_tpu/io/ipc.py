@@ -19,6 +19,7 @@ one table-wide dictionary via searchsorted remapping (no per-row decode).
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -74,11 +75,23 @@ def write_partition(path: str, batches: List[ColumnBatch]) -> Dict[str, int]:
         raise IoError("no batches to write")
     schema = rbs[0].schema
     num_rows = 0
-    with pa.OSFile(path, "wb") as sink:
-        with pa.ipc.new_file(sink, schema) as writer:
-            for rb in rbs:
-                writer.write_batch(rb)
-                num_rows += rb.num_rows
+    # write to a tmp file in the same dir then rename: concurrent writers
+    # of the same deterministic path (e.g. a speculative duplicate task)
+    # can never leave a half-written file visible to a fetching consumer
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    try:
+        with pa.OSFile(tmp, "wb") as sink:
+            with pa.ipc.new_file(sink, schema) as writer:
+                for rb in rbs:
+                    writer.write_batch(rb)
+                    num_rows += rb.num_rows
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     return {
         "num_rows": num_rows,
         "num_batches": len(rbs),

@@ -428,8 +428,12 @@ class JoinExec(PhysicalPlan):
             if bd is pd_:
                 out.append(None)  # shared dictionary: codes comparable
                 continue
-            ck = (bcol, id(pd_))
-            if ck not in self._remap_cache:
+            # cache holds the probe dictionary itself and is keyed per
+            # column (identity-compared on hit): a GC'd dictionary whose
+            # address is reused can't pick up a stale remap, and at most
+            # one dictionary per key column stays pinned
+            cached = self._remap_cache.get(bcol)
+            if cached is None or cached[0] is not pd_:
                 bvals = bd.values.astype(str)
                 pvals = pd_.values.astype(str)
                 if len(bvals):
@@ -439,8 +443,9 @@ class JoinExec(PhysicalPlan):
                     remap = np.where(ok, idx_c, -1).astype(np.int64)
                 else:
                     remap = np.full(max(len(pvals), 1), -1, np.int64)
-                self._remap_cache[ck] = jnp.asarray(remap)
-            out.append(self._remap_cache[ck])
+                cached = (pd_, jnp.asarray(remap))
+                self._remap_cache[bcol] = cached
+            out.append(cached[1])
         return tuple(out)
 
     def _probe_unique_batch(self, table, build_batch, pb: ColumnBatch,

@@ -265,3 +265,118 @@ def test_reap_requeues_running_tasks_of_dead_executor(tmp_path):
     nxt = state.next_task()
     assert nxt == PartitionId("j000002", 1, 0)
     assert state.next_task() is None
+
+
+def test_speculation_never_duplicates_onto_same_executor():
+    """The executor already running a straggler must not receive its own
+    duplicate: both copies would write the same deterministic work_dir
+    path concurrently (single-executor clusters made this deterministic
+    data corruption before the exclusion)."""
+    from ballista_tpu.distributed.types import JobStatus
+
+    state = SchedulerState(MemoryBackend())
+    state.save_job_status("j000003", JobStatus("running"))
+    state.save_stage_plan("j000003", 1, b"", 1, [])
+    state.save_task_status(TaskStatus(
+        PartitionId("j000003", 1, 0), "running", executor_id="e1",
+        started_at=time.time() - 120,
+    ))
+    # e1 (the straggler's own executor) asks: no duplicate
+    assert state.speculative_task(age_secs=60.0, executor_id="e1",
+                                  min_interval_secs=0.0) is None
+    # a different executor gets the duplicate
+    assert state.speculative_task(age_secs=60.0, executor_id="e2",
+                                  min_interval_secs=0.0) == \
+        PartitionId("j000003", 1, 0)
+
+
+def test_first_completion_wins_on_duplicate_reports():
+    """A speculative duplicate and the original can both finish; the
+    SECOND completion report must be dropped so consumers keep fetching
+    from the recorded (first) location."""
+    from ballista_tpu.distributed.types import ExecutorMeta, JobStatus
+
+    state = SchedulerState(MemoryBackend())
+    state.save_executor_metadata(ExecutorMeta("e1", "h1", 1, 1))
+    state.save_executor_metadata(ExecutorMeta("e2", "h2", 2, 1))
+    state.save_job_status("j000004", JobStatus("running"))
+    state.save_stage_plan("j000004", 1, b"", 1, [])
+    pid = PartitionId("j000004", 1, 0)
+    state.task_completed(TaskStatus(pid, "completed", executor_id="e1",
+                                    path="/w1/data.arrow"))
+    state.task_completed(TaskStatus(pid, "completed", executor_id="e2",
+                                    path="/w2/data.arrow"))
+    (st,) = state.get_task_statuses("j000004", 1)
+    assert st.executor_id == "e1" and st.path == "/w1/data.arrow"
+    locs = state.stage_locations("j000004")[1]
+    assert [(loc.host, loc.path) for loc in locs] == [("h1", "/w1/data.arrow")]
+
+
+def test_unroutable_location_fails_resolution_with_tagged_error():
+    """A completed task whose executor has NO address record (no lease,
+    no durable record) must raise the tagged ShuffleFetchError at
+    resolution time — never emit host='', port=0 for a consumer to trip
+    over."""
+    from ballista_tpu.distributed.types import JobStatus
+
+    state = SchedulerState(MemoryBackend())
+    state.save_job_status("j000005", JobStatus("running"))
+    state.save_stage_plan("j000005", 1, b"", 1, [])
+    state.save_task_status(TaskStatus(
+        PartitionId("j000005", 1, 0), "completed", executor_id="gone",
+        path="/lost/data.arrow",
+    ))
+    with pytest.raises(ShuffleFetchError) as ei:
+        state.stage_locations("j000005")
+    assert ei.value.stage_id == 1 and ei.value.partition_ids == [0]
+
+
+def test_atomic_partition_write_leaves_no_tmp(tmp_path):
+    """write_partition goes through tmp+rename so a concurrent duplicate
+    writer can never expose a half-written file."""
+    from ballista_tpu.columnar import ColumnBatch
+    from ballista_tpu.datatypes import Int64
+    from ballista_tpu.io import ipc
+
+    batch = ColumnBatch.from_numpy(
+        schema(("a", Int64)), {"a": np.arange(8, dtype=np.int64)}
+    )
+    path = str(tmp_path / "j" / "1" / "0" / "data.arrow")
+    stats = ipc.write_partition(path, [batch])
+    assert stats["num_rows"] == 8
+    leftovers = [p for p in (tmp_path / "j" / "1" / "0").iterdir()
+                 if p.name != "data.arrow"]
+    assert leftovers == []
+    # overwrite (duplicate completing later) also lands atomically
+    ipc.write_partition(path, [batch])
+    names, arrays, _, _, _ = ipc.read_partition_arrays(path)
+    assert names == ["a"] and len(arrays["a"]) == 8
+
+
+def test_failure_report_cannot_clobber_completed_task():
+    """The losing speculative duplicate may FAIL after the original
+    completed; that failure report must be dropped (no status clobber,
+    no spurious recovery)."""
+    from ballista_tpu.distributed.types import ExecutorMeta, JobStatus
+
+    svc = SchedulerService(SchedulerState(MemoryBackend()))
+    state = svc.state
+    state.save_executor_metadata(ExecutorMeta("e1", "h1", 1, 1))
+    state.save_job_status("j000006", JobStatus("running"))
+    state.save_stage_plan("j000006", 1, b"", 1, [])
+    pid = PartitionId("j000006", 1, 0)
+    state.task_completed(TaskStatus(pid, "completed", executor_id="e1",
+                                    path="/w1/data.arrow"))
+    params = pb.PollWorkParams(can_accept_task=False)
+    params.metadata.id = "e2"
+    params.metadata.host = "h2"
+    params.metadata.port = 2
+    params.metadata.num_devices = 1
+    ts = params.task_status.add()
+    ts.partition_id.job_id = "j000006"
+    ts.partition_id.stage_id = 1
+    ts.partition_id.partition_id = 0
+    ts.failed.error = "IoError: disk full on the duplicate"
+    svc.PollWork(params)
+    (st,) = state.get_task_statuses("j000006", 1)
+    assert st.state == "completed" and st.path == "/w1/data.arrow"
