@@ -49,9 +49,9 @@ class ExecutorConfig:
         self.host = host
         self.bind_host = bind_host if bind_host is not None else host
         self.port = port
-        # devices this executor owns (reported in PollWork metadata; the
-        # scheduler's mesh fusion relies on the operator setting
-        # mesh.devices consistently with the fleet)
+        # devices this executor owns (reported in PollWork metadata;
+        # mesh fusion is driven by these fleet reports — a client
+        # mesh.devices setting is only validated against them)
         self.num_devices = num_devices
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-")
         self.concurrent_tasks = concurrent_tasks

@@ -39,7 +39,7 @@ log = logging.getLogger("ballista.scheduler")
 SERVICE = "ballista_tpu.SchedulerGrpc"
 
 
-def _fuse_mesh_stages(stages, settings):
+def _fuse_mesh_stages(stages, n_mesh: int):
     """ICI fast path: collapse a hash-shuffle stage + its final-aggregate
     consumer into ONE MeshAggExec stage that runs the shuffle as an
     in-SPMD ``lax.all_to_all`` over the executor's device mesh instead of
@@ -47,21 +47,17 @@ def _fuse_mesh_stages(stages, settings):
     replaced: location-resolved file fetches, reference
     rust/scheduler/src/planner.rs:236-269 + shuffle_reader.rs:77-99).
 
-    Gated on the ``mesh.devices`` client setting (>= 2): fusion pins the
-    whole pair to one task, so the operator must know executors own that
-    many devices. Pattern matched exactly: consumer stage whose plan is
-    HashAggregateExec(final) over UnresolvedShuffleExec([S]) where S is a
-    hash-shuffle stage."""
+    ``n_mesh`` is the CLUSTER-resolved mesh width (executor-reported
+    device counts, see ``_cluster_mesh_devices``), not a client hint;
+    < 2 disables fusion. Pattern matched exactly: consumer stage whose
+    plan is HashAggregateExec(final) over UnresolvedShuffleExec([S])
+    where S is a hash-shuffle stage."""
     from ..physical import operators as ops
     from ..physical.aggregate import HashAggregateExec
     from ..physical.join import JoinExec
     from ..physical.mesh_agg import MeshAggExec, MeshJoinExec
     from ..physical.shuffle import QueryStageExec, UnresolvedShuffleExec
 
-    try:
-        n_mesh = int((settings or {}).get("mesh.devices", "0"))
-    except ValueError:
-        n_mesh = 0
     if n_mesh < 2:
         return stages
     from collections import Counter
@@ -161,6 +157,53 @@ def _fuse_mesh_stages(stages, settings):
     return [s for s in fused if s.stage_id not in dropped]
 
 
+def _cluster_mesh_devices(state: SchedulerState, settings,
+                          wait_secs: float = 3.0) -> int:
+    """Mesh width for fusion, resolved from EXECUTOR-REPORTED device
+    counts (each PollWork carries ``metadata.num_devices``) — the cluster
+    truth — rather than the client's ``mesh.devices`` hint. Rules:
+
+    - fleet uniformly reports n >= 2  -> fuse over n devices;
+    - fleet reports mixed counts      -> no fusion (warned), unless the
+      client claimed a width — then fail the job loudly;
+    - a client claim that contradicts the uniform fleet is an ERROR: a
+      lying (or stale) client must not change plan shape silently;
+    - no executors registered yet: wait briefly only if the client
+      claimed a mesh (cluster startup), else plan unfused.
+    """
+    try:
+        claimed = int((settings or {}).get("mesh.devices", "0"))
+    except ValueError:
+        claimed = 0
+    metas = state.get_executors_metadata()
+    if not metas and claimed >= 2:
+        deadline = time.time() + wait_secs
+        while not metas and time.time() < deadline:
+            time.sleep(0.1)
+            metas = state.get_executors_metadata()
+    if not metas:
+        return 0
+    reported = sorted({m.num_devices or 1 for m in metas})
+    if len(reported) > 1:
+        if claimed >= 2:
+            raise ClusterError(
+                f"mesh.devices={claimed} requested but executors report "
+                f"mixed device counts {reported}; mesh fusion needs a "
+                "uniform fleet"
+            )
+        log.warning("executors report mixed device counts %s: mesh "
+                    "fusion disabled", reported)
+        return 0
+    n = reported[0]
+    if claimed >= 2 and claimed != n:
+        raise ClusterError(
+            f"client requested mesh.devices={claimed} but executors "
+            f"uniformly report {n} device(s); refusing to plan against "
+            "the claimed mesh"
+        )
+    return n if n >= 2 else 0
+
+
 def _mesh_requirement(plan) -> int:
     """Devices a task of this stage needs (max over mesh-fused nodes;
     0 = any executor). Drives device-aware task assignment."""
@@ -240,7 +283,9 @@ class SchedulerService:
             phys = plan_logical(logical_plan,
                                 PlannerOptions.from_settings(settings))
             stages = DistributedPlanner().plan_query_stages(job_id, phys)
-            stages = _fuse_mesh_stages(stages, settings)
+            stages = _fuse_mesh_stages(
+                stages, _cluster_mesh_devices(self.state, settings)
+            )
             for stage in stages:
                 deps = [
                     sid
@@ -296,6 +341,14 @@ class SchedulerService:
                 # failure report must not clobber it or trigger recovery
                 log.info("dropping failure report for already-completed "
                          "task %s", st.partition.key())
+            elif st.state == "failed" and \
+                    self.state.absorb_speculative_failure(st.partition):
+                # one of two in-flight copies (original + speculative
+                # duplicate) failed while its twin may still succeed:
+                # don't fail the job or burn recovery budget yet
+                log.warning("absorbing first failure of speculated task "
+                            "%s; twin copy still in flight (%s)",
+                            st.partition.key(), st.error)
             elif st.state == "failed" and (
                 self.state.recover_fetch_failure(st)
                 or self.state.recover_transient_failure(st)

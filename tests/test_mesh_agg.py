@@ -60,7 +60,7 @@ def test_fusion_pattern_and_serde(eight_devices, tmp_path):
     # unfused: a hash-shuffle producer stage + a final-agg consumer
     assert any(s.shuffle_hash_exprs for s in stages)
 
-    fused = _fuse_mesh_stages(stages, {"mesh.devices": "8"})
+    fused = _fuse_mesh_stages(stages, 8)
     assert len(fused) == len(stages) - 1
     mesh_stage = fused[-1]
     assert isinstance(mesh_stage.child, MeshAggExec)
@@ -70,8 +70,8 @@ def test_fusion_pattern_and_serde(eight_devices, tmp_path):
     assert isinstance(rt, MeshAggExec) and rt.n_devices == 8
     assert [e.name() for e in rt.hash_exprs] == ["k"]
 
-    # gate respected: no setting -> untouched
-    assert _fuse_mesh_stages(stages, {}) == stages
+    # gate respected: no mesh -> untouched
+    assert _fuse_mesh_stages(stages, 0) == stages
 
 
 def test_mesh_task_assignment_respects_num_devices():
@@ -203,5 +203,67 @@ def test_cluster_file_shuffle_without_mesh_setting(eight_devices, tmp_path):
                 shuffle_files += [f for f in files
                                   if f.startswith("shuffle-")]
         assert shuffle_files, "expected host shuffle files on the file path"
+    finally:
+        cluster.shutdown()
+
+
+def _wait_registered(cluster, n=1, t=5.0):
+    import time
+
+    deadline = time.time() + t
+    while len(cluster.state.get_executors_metadata()) < n:
+        assert time.time() < deadline, "executors never registered"
+        time.sleep(0.05)
+
+
+def test_mesh_fusion_driven_by_fleet_reports(eight_devices, tmp_path):
+    """Fusion fires with NO client mesh.devices setting: the scheduler
+    reads the fleet's uniformly-reported num_devices (PollWork metadata)
+    — cluster truth, not a client hint."""
+    src, df = _mem(tmp_path, n=600, mod=19)
+    cluster = LocalCluster(num_executors=1, concurrent_tasks=2,
+                          num_devices=8)
+    try:
+        _wait_registered(cluster)
+        ctx = BallistaContext.remote("localhost", cluster.port,
+                                     **{"agg.partitions": "8"})
+        ctx.register_source("t", src)
+        got = ctx.sql(
+            "select k, sum(v) as sv from t group by k order by k"
+        ).collect()
+        exp = df.groupby("k").agg(sv=("v", "sum")).reset_index() \
+            .sort_values("k")
+        np.testing.assert_array_equal(got["k"], exp["k"])
+        np.testing.assert_array_equal(got["sv"].astype(np.int64),
+                                      exp["sv"].astype(np.int64))
+        # fused => the exchange rode all_to_all: zero shuffle files
+        shuffle_files = []
+        for e in cluster.executors:
+            for root, _, files in os.walk(e.config.work_dir):
+                shuffle_files += [f for f in files
+                                  if f.startswith("shuffle-")]
+        assert shuffle_files == [], \
+            f"fleet-driven fusion did not fire: {shuffle_files}"
+    finally:
+        cluster.shutdown()
+
+
+def test_lying_client_cannot_change_plan_shape(eight_devices, tmp_path):
+    """A client claiming mesh.devices=8 against a 1-device fleet must
+    fail the job loudly — never silently fuse OR silently unfuse."""
+    from ballista_tpu.errors import ClusterError
+
+    src, _ = _mem(tmp_path, n=100, mod=5)
+    cluster = LocalCluster(num_executors=1, concurrent_tasks=2,
+                          num_devices=1)
+    try:
+        _wait_registered(cluster)
+        ctx = BallistaContext.remote(
+            "localhost", cluster.port,
+            **{"agg.partitions": "4", "mesh.devices": "8"},
+        )
+        ctx.register_source("t", src)
+        with pytest.raises(ClusterError, match="mesh.devices=8"):
+            ctx.sql("select k, sum(v) as sv from t group by k").collect()
     finally:
         cluster.shutdown()
