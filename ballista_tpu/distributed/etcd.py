@@ -61,8 +61,13 @@ class EtcdBackend(KvBackend):
         self.channel = grpc.insecure_channel(target)
         self._lock_ttl = lock_ttl_secs
         # key -> lease id of the previous leased put, revoked on renewal
-        # so heartbeat writes don't accrue orphan leases until TTL
+        # so heartbeat writes don't accrue orphan leases until TTL.
+        # Leased puts serialize PER KEY (the race is per-key; a global
+        # lock would convoy every executor's heartbeat behind ~3 etcd
+        # RPCs of whichever arrived first); _key_leases_mu only guards
+        # the lock-table itself
         self._key_leases: Dict[str, int] = {}
+        self._key_locks: Dict[str, threading.Lock] = {}
         self._key_leases_mu = threading.Lock()
 
         def stub(service, method, resp_t):
@@ -100,11 +105,13 @@ class EtcdBackend(KvBackend):
         # etcd lease TTLs are fixed at grant time (extending needs the
         # streaming KeepAlive RPC), so each leased write re-grants and
         # revokes the key's PREVIOUS lease to avoid accumulation. The
-        # whole grant+put+record+revoke sequence is serialized: two
-        # interleaved heartbeat puts could otherwise record the live
-        # lease as "old" and revoke it, deleting the key and making the
-        # executor look dead until its next heartbeat.
+        # whole grant+put+record+revoke sequence is serialized per key:
+        # two interleaved puts of the SAME key could otherwise record
+        # the live lease as "old" and revoke it, deleting the key and
+        # making the executor look dead until its next heartbeat.
         with self._key_leases_mu:
+            klock = self._key_locks.setdefault(key, threading.Lock())
+        with klock:
             lease_id = self._grant(
                 epb.LeaseGrantRequest(TTL=lease_secs)
             ).ID

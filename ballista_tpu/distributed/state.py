@@ -170,9 +170,11 @@ class SchedulerState:
         # (job, stage) -> devices a task needs (0 = any)
         self._stage_mesh: Dict[Tuple[str, int], int] = {}
         # tasks already handed out as speculative duplicates (at most one
-        # duplicate per task), and the last speculation scan time — both
-        # guarded by self._lock
+        # duplicate per task), tasks with one absorbed failure while a
+        # twin copy was still in flight, and the last speculation scan
+        # time — all guarded by self._lock
         self._speculated: set = set()
+        self._spec_failed_once: set = set()
         self._last_spec_scan = 0.0
         self._rehydrate()
 
@@ -550,6 +552,10 @@ class SchedulerState:
         with self._lock:
             if now - self._last_spec_scan < min_interval_secs:
                 return None
+            # stamp BEFORE scanning (atomic check-and-set like
+            # reap_lost_tasks) so concurrent idle polls can't all start
+            # full scans; cleared again if this scan finds a candidate
+            self._last_spec_scan = now
         for k, v in self.kv.get_from_prefix(self._k("jobs")):
             if pickle.loads(v).state not in ("queued", "running"):
                 continue
@@ -566,10 +572,24 @@ class SchedulerState:
                         if need and num_devices and num_devices < need:
                             continue
                         self._speculated.add(key)
+                        # a successful scan doesn't delay the next one
+                        self._last_spec_scan = 0.0
                         return t.partition
-        with self._lock:
-            self._last_spec_scan = now
         return None
+
+    def absorb_speculative_failure(self, pid: PartitionId) -> bool:
+        """A task with an in-flight speculative duplicate reported a
+        failure while its twin may still be running: absorb the FIRST
+        such failure (return True — the caller must not record it or
+        trigger recovery); the second failure means both copies died and
+        flows through the normal failure path."""
+        with self._lock:
+            if pid not in self._speculated or self.is_completed(pid):
+                return False
+            if pid in self._spec_failed_once:
+                return False
+            self._spec_failed_once.add(pid)
+            return True
 
     def reap_lost_tasks(self, min_interval_secs: float = 5.0) -> List[str]:
         """Re-queue running tasks whose executor's lease has expired (the

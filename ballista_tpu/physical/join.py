@@ -428,12 +428,13 @@ class JoinExec(PhysicalPlan):
             if bd is pd_:
                 out.append(None)  # shared dictionary: codes comparable
                 continue
-            # cache holds the probe dictionary itself and is keyed per
-            # column (identity-compared on hit): a GC'd dictionary whose
-            # address is reused can't pick up a stale remap, and at most
-            # one dictionary per key column stays pinned
+            # cache holds BOTH dictionaries and is keyed per column
+            # (identity-compared on hit): a GC'd dictionary whose address
+            # is reused can't pick up a stale remap, a per-partition
+            # build dictionary can't reuse another partition's remap, and
+            # at most one pair per key column stays pinned
             cached = self._remap_cache.get(bcol)
-            if cached is None or cached[0] is not pd_:
+            if cached is None or cached[0] is not bd or cached[1] is not pd_:
                 bvals = bd.values.astype(str)
                 pvals = pd_.values.astype(str)
                 if len(bvals):
@@ -443,9 +444,9 @@ class JoinExec(PhysicalPlan):
                     remap = np.where(ok, idx_c, -1).astype(np.int64)
                 else:
                     remap = np.full(max(len(pvals), 1), -1, np.int64)
-                cached = (pd_, jnp.asarray(remap))
+                cached = (bd, pd_, jnp.asarray(remap))
                 self._remap_cache[bcol] = cached
-            out.append(cached[1])
+            out.append(cached[2])
         return tuple(out)
 
     def _probe_unique_batch(self, table, build_batch, pb: ColumnBatch,

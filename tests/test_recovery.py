@@ -380,3 +380,25 @@ def test_failure_report_cannot_clobber_completed_task():
     svc.PollWork(params)
     (st,) = state.get_task_statuses("j000006", 1)
     assert st.state == "completed" and st.path == "/w1/data.arrow"
+
+
+def test_first_failure_of_speculated_task_is_absorbed():
+    """When a task has an in-flight speculative duplicate, ONE failure
+    report must not fail the job (the twin may still succeed); a second
+    failure flows through the normal path."""
+    from ballista_tpu.distributed.types import JobStatus
+
+    state = SchedulerState(MemoryBackend())
+    state.save_job_status("j000007", JobStatus("running"))
+    state.save_stage_plan("j000007", 1, b"", 1, [])
+    pid = PartitionId("j000007", 1, 0)
+    state.save_task_status(TaskStatus(pid, "running", executor_id="e1",
+                                      started_at=time.time() - 120))
+    dup = state.speculative_task(age_secs=60.0, executor_id="e2",
+                                 min_interval_secs=0.0)
+    assert dup == pid
+    assert state.absorb_speculative_failure(pid)      # first: absorbed
+    assert not state.absorb_speculative_failure(pid)  # second: real
+    # a task WITHOUT a duplicate never absorbs
+    other = PartitionId("j000007", 1, 99)
+    assert not state.absorb_speculative_failure(other)
