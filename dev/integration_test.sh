@@ -1,15 +1,19 @@
 #!/usr/bin/env bash
 # Integration gate (reference parity: dev/integration-tests.sh builds
 # images, generates data, runs the compose cluster + query subset; here:
-# native build, fast suite incl. the process-level binary cluster test,
-# then the benchmark smoke). Opt into the SF0.2 scale suite with
-#   RUN_SF02=1 dev/integration_test.sh
+# native build, the full suite INCLUDING the SF0.2 scale tier (all 22
+# TPC-H queries through standalone AND the cluster — the scale-dependent
+# paths: overflow, compaction, partitioned joins, recovery), then the
+# benchmark smoke. Budget: ~6min on a 1-core box (~2min fast tier +
+# ~160s SF0.2 + bench). Skip the scale tier for quick iteration with
+#   FAST_ONLY=1 dev/integration_test.sh
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 make -C ballista_tpu/native
-python -m pytest tests/ -q
-if [[ "${RUN_SF02:-0}" == "1" ]]; then
-  python -m pytest tests/test_tpch_sf02.py -m sf02 -q
+if [[ "${FAST_ONLY:-0}" == "1" ]]; then
+  python -m pytest tests/ -q -m "not sf02"
+else
+  python -m pytest tests/ -q
 fi
 python bench.py --cpu --scale 0.2 --runs 2
