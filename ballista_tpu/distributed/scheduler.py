@@ -116,18 +116,19 @@ def _fuse_mesh_stages(stages, n_mesh: int):
             # the subtree; everything above it runs on host over the
             # fused single-partition output
             def replace_join(node):
-                if (isinstance(node, JoinExec) and node.partitioned
-                        and node.how == "inner"):
+                if isinstance(node, JoinExec) and node.partitioned:
                     bprod = _shuffle_producer(node.build)
                     pprod = _shuffle_producer(node.probe)
                     if bprod is not None and pprod is not None:
                         dropped.update({bprod.stage_id, pprod.stage_id})
                         log.info(
                             "fused stages %d+%d+%d into a %d-device mesh "
-                            "shuffle-join", bprod.stage_id, pprod.stage_id,
-                            stage.stage_id, n_mesh)
+                            "shuffle-join (how=%s)", bprod.stage_id,
+                            pprod.stage_id, stage.stage_id, n_mesh,
+                            node.how)
                         return MeshJoinExec(bprod.child, pprod.child,
-                                            node.on, "inner", n_mesh)
+                                            node.on, node.how, n_mesh,
+                                            null_aware=node.null_aware)
                 kids = node.children()
                 if not kids:
                     return node

@@ -28,7 +28,7 @@ from typing import Iterator, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..columnar import Column, ColumnBatch, round_capacity
 from ..datatypes import Schema
@@ -38,54 +38,9 @@ from ..kernels import mesh_shuffle
 from ..kernels.expr_eval import Evaluator
 from ..parallel.mesh import make_mesh
 from .aggregate import DEFAULT_GROUP_CAPACITY, HashAggregateExec
-from .base import PhysicalPlan, Partitioning, concat_batches
+from .base import PhysicalPlan, Partitioning
 
 
-
-
-def _run_producer_over_mesh(producer: PhysicalPlan, schema: Schema,
-                            n_devices: int):
-    """Run a producer plan on host and lay its live rows out round-robin
-    over the mesh slots (uniform capacity, materialized validity so every
-    slot shares one pytree structure). Returns (device_batches, big)."""
-    batches = []
-    for p in range(producer.output_partitioning().num_partitions):
-        batches.extend(producer.execute(p))
-    if not batches:
-        from ..columnar import empty_batch
-
-        batches = [empty_batch(schema)]
-    big = concat_batches(schema, batches)  # unifies dictionaries
-    sel = np.asarray(big.selection)
-    rows = np.flatnonzero(sel)
-    chunks = np.array_split(rows, n_devices)
-    cap = round_capacity(max((len(c) for c in chunks), default=1) or 1)
-    out = []
-    for c in chunks:
-        cols = []
-        for col in big.columns:
-            vals = np.zeros((cap,), np.asarray(col.values).dtype)
-            vals[: len(c)] = np.asarray(col.values)[c]
-            valid = np.zeros((cap,), bool)
-            if col.validity is not None:
-                valid[: len(c)] = np.asarray(col.validity)[c]
-            else:
-                valid[: len(c)] = True
-            cols.append(Column(jnp.asarray(vals), col.dtype,
-                               jnp.asarray(valid), col.dictionary))
-        live = np.zeros((cap,), bool)
-        live[: len(c)] = True
-        out.append(ColumnBatch(
-            schema, cols, jnp.asarray(live), jnp.asarray(np.int32(len(c))),
-        ))
-    return out, big
-
-
-def _stack_device_batches(device_batches):
-    return jax.tree.map(
-        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
-        *device_batches,
-    )
 
 
 def _shuffle_side(b: ColumnBatch, hash_exprs, ev: Evaluator, n_dev: int,
@@ -171,12 +126,6 @@ class MeshAggExec(PhysicalPlan):
 
     # -- execution -----------------------------------------------------------
 
-    def _device_batches(self) -> List[ColumnBatch]:
-        out, _big = _run_producer_over_mesh(self.producer,
-                                            self._partial_schema,
-                                            self.n_devices)
-        return out
-
     def _spmd(self, stacked, mesh, cap: int, in_cap: int):
         """(stacked batch pytree) -> (stacked out batch, num_groups[n])."""
         from functools import partial
@@ -184,38 +133,47 @@ class MeshAggExec(PhysicalPlan):
         from ..parallel.mesh import shard_map  # version-guarded import
 
         n_dev = self.n_devices
+        cache = self.__dict__.setdefault("_spmd_jit", {})
+        key = (mesh, cap, in_cap, jax.tree.structure(stacked))
+        if key not in cache:
+            final_fn = self._final._get_grouped_fn(cap, n_dev * in_cap)
 
-        final_fn = self._final._get_grouped_fn(cap, n_dev * in_cap)
+            @partial(shard_map, mesh=mesh, in_specs=(P("data"),),
+                     out_specs=(P("data"), P("data")), check_vma=False)
+            def run(stacked_b):
+                b = jax.tree.map(lambda x: x[0], stacked_b)
+                b2 = _shuffle_side(b, self.hash_exprs, self._ev, n_dev,
+                                   in_cap)
+                out_batch, num_groups = final_fn(b2)
+                return (
+                    jax.tree.map(lambda x: x[None], out_batch),
+                    num_groups[None],
+                )
 
-        @partial(shard_map, mesh=mesh, in_specs=(P("data"),),
-                 out_specs=(P("data"), P("data")), check_vma=False)
-        def run(stacked_b):
-            b = jax.tree.map(lambda x: x[0], stacked_b)
-            b2 = _shuffle_side(b, self.hash_exprs, self._ev, n_dev, in_cap)
-            out_batch, num_groups = final_fn(b2)
-            return (
-                jax.tree.map(lambda x: x[None], out_batch),
-                num_groups[None],
-            )
+            cache[key] = jax.jit(run)
+        return cache[key](stacked)
 
-        return run(stacked)
+    def execute_stacked(self, mesh) -> ColumnBatch:
+        """Device-resident execution: stacked [n_dev, cap] output sharded
+        over the mesh — consumed directly by a chained fused stage (HBM
+        partition cache) or sliced per device by ``execute``."""
+        from .mesh_input import stacked_input
 
-    def execute(self, partition: int) -> Iterator[ColumnBatch]:
-        if partition != 0:
-            raise ExecutionError("MeshAggExec has a single output partition")
-        mesh = make_mesh(self.n_devices)
-        device_batches = self._device_batches()
-        in_cap = device_batches[0].capacity
-        stacked = _stack_device_batches(device_batches)
-        sharding = NamedSharding(mesh, P("data"))
-        stacked = jax.device_put(stacked, sharding)
+        stacked, in_cap = stacked_input(self.producer, self._partial_schema,
+                                        mesh)
         cap = self.group_capacity
         while True:
             out_stacked, num_groups = self._spmd(stacked, mesh, cap, in_cap)
             ng = int(np.max(np.asarray(num_groups)))
             if ng <= cap:
-                break
+                return out_stacked
             cap = round_capacity(ng)  # overflow: recompile with exact cap
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        if partition != 0:
+            raise ExecutionError("MeshAggExec has a single output partition")
+        mesh = make_mesh(self.n_devices)
+        out_stacked = self.execute_stacked(mesh)
         for q in range(self.n_devices):
             yield jax.tree.map(lambda x, _q=q: jnp.asarray(x)[_q],
                                out_stacked)
@@ -228,25 +186,30 @@ def _partition_ids(batch: ColumnBatch, hash_exprs, n_dev: int,
     return compute_partition_ids(batch, hash_exprs, n_dev, 0, ev)
 
 class MeshJoinExec(PhysicalPlan):
-    """Mesh-fused co-partitioned INNER join: BOTH join inputs are
-    exchanged over ICI ``lax.all_to_all`` (hashed on the join keys) and
-    joined per device in the same SPMD program — BASELINE config 4's
-    shape ("q5 shuffle -> ICI all_to_all") with zero shuffle files.
+    """Mesh-fused co-partitioned join: BOTH join inputs are exchanged
+    over ICI ``lax.all_to_all`` (hashed on the join keys) and joined per
+    device in the same SPMD program — BASELINE config 4's shape
+    ("q5 shuffle -> ICI all_to_all") with zero shuffle files.
 
     Built by the scheduler's fusion pass from a partitioned JoinExec
-    stage and its two hash-shuffle producer stages. v1 scope: inner
-    joins (outer/semi/anti keep the host path). Key representation is
-    raw values for one key column, the exact rank codec otherwise —
-    decided statically, no host-side range checks. Output: a single
-    partition containing every device's joined rows (adaptive output
-    capacity with whole-SPMD retry on overflow, like MeshAggExec).
+    stage and its two hash-shuffle producer stages. Supports every host
+    join type (inner/left/semi/anti/full): co-partitioning makes
+    unmatched-row detection local to each device, so outer rows are
+    appended after the matched expansion in the same static output
+    buffer (host semantics: physical/join.py:292-357). Key
+    representation is raw values for one key column, the exact rank
+    codec otherwise — decided statically, no host-side range checks.
+    Output: a single partition containing every device's joined rows
+    (adaptive output capacity with whole-SPMD retry on overflow, like
+    MeshAggExec).
     """
 
     def __init__(self, build_producer: PhysicalPlan,
                  probe_producer: PhysicalPlan, on, how: str,
-                 n_devices: int):
-        if how != "inner":
-            raise ExecutionError("MeshJoinExec supports inner joins only")
+                 n_devices: int, null_aware: bool = False):
+        if how not in ("inner", "left", "semi", "anti", "full"):
+            raise ExecutionError(f"MeshJoinExec join type {how}")
+        self.null_aware = null_aware
         self.build_producer = build_producer
         self.probe_producer = probe_producer
         self.on = list(on)
@@ -276,7 +239,7 @@ class MeshJoinExec(PhysicalPlan):
 
     def with_new_children(self, children):
         return MeshJoinExec(children[0], children[1], self.on, self.how,
-                            self.n_devices)
+                            self.n_devices, self.null_aware)
 
     def display(self) -> str:
         on = ", ".join(f"{l}={r}" for l, r in self.on)
@@ -300,9 +263,16 @@ class MeshJoinExec(PhysicalPlan):
         out_schema = self.output_schema()
         probe_schema = self.probe_producer.output_schema()
 
-        @fpartial(shard_map, mesh=mesh, in_specs=(P("data"), P("data")),
+        cache = self.__dict__.setdefault("_spmd_jit", {})
+        key = (mesh, out_cap, b_cap, p_cap,
+               jax.tree.structure((stacked_b, stacked_p, remaps)))
+        if key in cache:
+            return cache[key](stacked_b, stacked_p, remaps)
+
+        @fpartial(shard_map, mesh=mesh,
+                  in_specs=(P("data"), P("data"), P()),
                   out_specs=(P("data"), P("data")), check_vma=False)
-        def run(sb, sp):
+        def run(sb, sp, remaps):
             b = jax.tree.map(lambda x: x[0], sb)
             p = jax.tree.map(lambda x: x[0], sp)
             b2 = _shuffle_side(b, bhash, self._build_ev, n_dev, b_cap)
@@ -325,46 +295,139 @@ class MeshJoinExec(PhysicalPlan):
                 pk, plive = self._join._probe_keys(p2, "codec",
                                                    (tables, nlive), remaps)
             table = join_k.build_lookup(bk, blive)
+
+            if self.how in ("semi", "anti"):
+                # membership only: probe-aligned output, no expansion
+                matched = join_k.probe_semi(table, pk, plive)
+                if self.how == "semi":
+                    sel = jnp.logical_and(p2.selection, matched)
+                else:
+                    sel = jnp.logical_and(p2.selection,
+                                          jnp.logical_not(matched))
+                    if self.null_aware:
+                        # SQL NOT IN: a null key ANYWHERE in the build
+                        # side (any device) makes the predicate never
+                        # true; null-key probe rows are dropped too
+                        bnull = jnp.logical_and(b2.selection,
+                                                jnp.logical_not(blive))
+                        bnull_any = jax.lax.pmax(
+                            jnp.max(bnull.astype(jnp.int32)), "data") > 0
+                        for _, pcol in self.on:
+                            vv = p2.column(pcol).validity
+                            if vv is not None:
+                                sel = jnp.logical_and(sel, vv)
+                        sel = jnp.logical_and(sel,
+                                              jnp.logical_not(bnull_any))
+                out = p2.with_selection(sel)
+                need = jnp.zeros((), jnp.int32)
+                return jax.tree.map(lambda x: x[None], out), need[None]
+
             prows, brows, olive, total = join_k.probe_expand(
                 table, pk, plive, out_cap)
+            need = total
+            C = out_cap
+            # outer rows: co-partitioning makes unmatched detection
+            # local; append them after the matched expansion in the same
+            # static buffer (overflow rides the same retry as matches)
+            sidx_p = sidx_b = None
+            n_up = jnp.zeros((), jnp.int32)
+            if self.how in ("left", "full"):
+                counts = join_k.probe_counts(table, pk)
+                un_p = jnp.logical_and(
+                    p2.selection,
+                    jnp.logical_or(jnp.logical_not(plive), counts == 0))
+                rank_p = jnp.cumsum(un_p.astype(jnp.int32)) - un_p
+                n_up = jnp.sum(un_p.astype(jnp.int32))
+                sidx_p = jnp.where(un_p, total + rank_p, C)  # C drops
+                need = need + n_up
+            if self.how == "full":
+                pt = join_k.build_lookup(pk, plive)
+                _, bmat = join_k.probe_unique(pt, bk, blive)
+                un_b = jnp.logical_and(
+                    b2.selection,
+                    jnp.logical_not(jnp.logical_and(blive, bmat)))
+                rank_b = jnp.cumsum(un_b.astype(jnp.int32)) - un_b
+                sidx_b = jnp.where(un_b, total + n_up + rank_b, C)
+                need = need + jnp.sum(un_b.astype(jnp.int32))
+
+            live = olive
+            if sidx_p is not None:
+                live = live.at[sidx_p].set(True, mode="drop")
+            if sidx_b is not None:
+                live = live.at[sidx_b].set(True, mode="drop")
+
             cols = []
             for f in out_schema.fields:
-                src = p2 if probe_schema.has_field(f.name) else b2
-                rows = prows if probe_schema.has_field(f.name) else brows
+                from_probe = probe_schema.has_field(f.name)
+                src = p2 if from_probe else b2
+                rows = prows if from_probe else brows
                 c = src.column(f.name)
                 vals = jnp.take(c.values, rows)
                 validity = (jnp.take(c.validity, rows)
                             if c.validity is not None else None)
+                src_valid = (c.validity if c.validity is not None
+                             else True)
+                if from_probe:
+                    if sidx_p is not None:
+                        vals = vals.at[sidx_p].set(c.values, mode="drop")
+                        if validity is not None:
+                            validity = validity.at[sidx_p].set(
+                                src_valid, mode="drop")
+                    if sidx_b is not None:  # probe cols null on
+                        if validity is None:  # build-only rows
+                            validity = jnp.ones((C,), jnp.bool_)
+                        validity = validity.at[sidx_b].set(
+                            False, mode="drop")
+                else:
+                    if sidx_p is not None:  # build cols null on
+                        if validity is None:  # probe-only rows
+                            validity = jnp.ones((C,), jnp.bool_)
+                        validity = validity.at[sidx_p].set(
+                            False, mode="drop")
+                    if sidx_b is not None:
+                        vals = vals.at[sidx_b].set(c.values, mode="drop")
+                        validity = validity.at[sidx_b].set(
+                            src_valid, mode="drop")
                 cols.append(Column(vals, f.dtype, validity, c.dictionary))
-            out = ColumnBatch(out_schema, cols, olive,
-                              jnp.sum(olive).astype(jnp.int32))
-            return jax.tree.map(lambda x: x[None], out), total[None]
+            out = ColumnBatch(out_schema, cols, live,
+                              jnp.sum(live).astype(jnp.int32))
+            return jax.tree.map(lambda x: x[None], out), need[None]
 
-        return run(stacked_b, stacked_p)
+        cache[key] = jax.jit(run)
+        return cache[key](stacked_b, stacked_p, remaps)
 
-    def execute(self, partition: int) -> Iterator[ColumnBatch]:
-        if partition != 0:
-            raise ExecutionError("MeshJoinExec has a single output partition")
-        mesh = make_mesh(self.n_devices)
-        bdev, bbig = _run_producer_over_mesh(
-            self.build_producer, self.build_producer.output_schema(),
-            self.n_devices)
-        pdev, pbig = _run_producer_over_mesh(
-            self.probe_producer, self.probe_producer.output_schema(),
-            self.n_devices)
-        remaps = self._join._remaps_for(bbig, pbig)
-        sharding = NamedSharding(mesh, P("data"))
-        sb = jax.device_put(_stack_device_batches(bdev), sharding)
-        sp = jax.device_put(_stack_device_batches(pdev), sharding)
-        b_cap, p_cap = bdev[0].capacity, pdev[0].capacity
+    def execute_stacked(self, mesh) -> ColumnBatch:
+        """Device-resident execution: both inputs laid out over the mesh
+        (or taken straight from chained fused producers), joined in one
+        SPMD program; stacked [n_dev, out_cap] output stays sharded."""
+        from .mesh_input import stacked_input
+
+        sb, b_cap = stacked_input(
+            self.build_producer, self.build_producer.output_schema(), mesh)
+        sp, p_cap = stacked_input(
+            self.probe_producer, self.probe_producer.output_schema(), mesh)
+        remaps = self._join._remaps_for(sb, sp)
         out_cap = self.n_devices * p_cap  # post-shuffle probe rows/device
+        if self.how == "full":  # + room for unmatched build rows
+            out_cap = round_capacity(out_cap + self.n_devices * b_cap)
         while True:
             out_stacked, totals = self._spmd(sb, sp, mesh, remaps, out_cap,
                                              b_cap, p_cap)
             t = int(np.max(np.asarray(totals)))
             if t <= out_cap:
-                break
+                return out_stacked
             out_cap = round_capacity(t)  # duplicate-heavy keys: retry
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        if partition != 0:
+            raise ExecutionError("MeshJoinExec has a single output partition")
+        from .base import maybe_compact
+
+        mesh = make_mesh(self.n_devices)
+        out_stacked = self.execute_stacked(mesh)
         for q in range(self.n_devices):
-            yield jax.tree.map(lambda x, _q=q: jnp.asarray(x)[_q],
-                               out_stacked)
+            # selective joins (semi/anti especially) leave mostly-dead
+            # slices; shrink them like the host join does before handing
+            # batches to downstream host operators
+            yield maybe_compact(jax.tree.map(
+                lambda x, _q=q: jnp.asarray(x)[_q], out_stacked))

@@ -1,0 +1,377 @@
+"""Device-resident input assembly for mesh-fused stages.
+
+Replaces the round-2 host funnel: fused-stage producers used to execute on
+host, get concatenated in numpy, and be re-uploaded per stage
+(`np.asarray` of every column). Now producer partitions are executed with
+their output pinned round-robin across the mesh devices, laid out into
+uniform per-device batches ON DEVICE (dictionary remap + concat + compact
+are XLA gathers), and assembled into one sharded global array with
+``jax.make_array_from_single_device_arrays`` — data never round-trips
+host memory; only per-slot live-row COUNTS (int32 scalars) sync to pick
+the uniform capacity.
+
+Chaining: when a fused stage's producer is itself a mesh-fused operator
+(or a projection/filter/partial-aggregate pipeline over one), the
+producer's stacked per-device output is fed straight into the consumer's
+SPMD program — an HBM-resident stage boundary. This is SURVEY §7's
+"device-memory partition cache": consecutive fused stages exchange data
+over ICI only (reference model being replaced: materialized IPC files +
+rust/core/src/execution_plans/shuffle_reader.rs:77-99).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..columnar import (
+    Column,
+    ColumnBatch,
+    Dictionary,
+    empty_batch,
+    round_capacity,
+)
+from ..datatypes import Schema
+from ..parallel.mesh import shard_map
+
+# Instrumentation (tests assert the device path actually ran):
+#   slot_assemblies — producer outputs laid out over the mesh on device;
+#   chained_stages  — stage inputs taken straight from a fused producer's
+#                     stacked HBM output (no re-assembly at all).
+STATS = {"slot_assemblies": 0, "chained_stages": 0}
+
+
+def reset_stats() -> None:
+    STATS["slot_assemblies"] = 0
+    STATS["chained_stages"] = 0
+
+
+# ---------------------------------------------------------------------------
+# dictionary unification (host metadata only; code remap is a device gather)
+# ---------------------------------------------------------------------------
+
+
+def _union_dicts(schema: Schema, batches: List[ColumnBatch]):
+    """Per field: one shared dictionary for every batch + per-batch int32
+    remap tables (None where codes are already in the shared space).
+    Only dictionary VALUES (host metadata) are touched; row data stays on
+    device."""
+    n_fields = len(schema.fields)
+    remaps = [[None] * n_fields for _ in batches]
+    dicts: List[Optional[Dictionary]] = []
+    for i in range(n_fields):
+        ds = [b.columns[i].dictionary for b in batches]
+        d0 = next((d for d in ds if d is not None), None)
+        if d0 is None:
+            dicts.append(None)
+            continue
+        if all(d is None or d is d0 for d in ds):
+            dicts.append(d0)
+            continue
+        union = np.unique(np.concatenate(
+            [np.asarray(d.values, dtype=object) for d in ds if d is not None]
+        ))
+        union_str = union.astype(str)
+        ud = Dictionary(union)
+        for bi, d in enumerate(ds):
+            if d is None or len(d) == 0:
+                continue
+            remaps[bi][i] = np.searchsorted(
+                union_str, d.values.astype(str)
+            ).astype(np.int32)
+        dicts.append(ud)
+    return dicts, remaps
+
+
+def _apply_remaps(schema: Schema, b: ColumnBatch, remap_row, dicts
+                  ) -> ColumnBatch:
+    """Rebind a batch to the shared dictionaries (device-side code
+    gather); also normalizes the schema object so every slot shares one
+    pytree aux."""
+    cols = []
+    for col, r, ud in zip(b.columns, remap_row, dicts):
+        d = col.dictionary
+        vals = col.values
+        if ud is not None:
+            if r is not None and d is not ud:
+                vals = jnp.take(jnp.asarray(r), vals.astype(jnp.int32),
+                                mode="clip")
+            d = ud
+        cols.append(Column(vals, col.dtype, col.validity, d))
+    return ColumnBatch(schema, cols, b.selection, b.num_rows)
+
+
+# ---------------------------------------------------------------------------
+# per-slot layout: compact live rows into a uniform fixed capacity
+# ---------------------------------------------------------------------------
+
+
+def _compact_impl(big: ColumnBatch, cap: int) -> ColumnBatch:
+    """Gather live rows to the front of a [cap] batch (validity
+    materialized so every slot shares one pytree structure). Traced."""
+    n = big.capacity
+    dead = jnp.logical_not(big.selection)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    _, perm = jax.lax.sort((dead, idx), num_keys=1, is_stable=True)
+    if cap <= n:
+        perm = perm[:cap]
+    else:
+        perm = jnp.concatenate(
+            [perm, jnp.zeros((cap - n,), jnp.int32)]
+        )
+    live = jnp.arange(cap, dtype=jnp.int32) < big.num_rows
+    cols = []
+    for col in big.columns:
+        vals = jnp.take(col.values, perm)
+        validity = (
+            jnp.take(col.validity, perm)
+            if col.validity is not None
+            else jnp.ones((cap,), jnp.bool_)
+        )
+        cols.append(Column(vals, col.dtype, jnp.logical_and(validity, live),
+                           col.dictionary))
+    return ColumnBatch(big.schema, cols, live,
+                       big.num_rows.astype(jnp.int32))
+
+
+_compact_to = partial(jax.jit, static_argnames=("cap",))(_compact_impl)
+
+
+# ---------------------------------------------------------------------------
+# mesh assembly
+# ---------------------------------------------------------------------------
+
+
+def stack_to_mesh(slot_batches: List[ColumnBatch], mesh):
+    """Per-device batches -> one stacked ColumnBatch pytree whose leaves
+    are [n_dev, ...] arrays sharded over the mesh axis. Each slot's
+    leaves are placed on their device (a device-to-device copy when the
+    slot was computed elsewhere — ICI, never host) and assembled without
+    any global materialization."""
+    devices = list(mesh.devices.flat)
+    n = len(devices)
+    sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+
+    def build(*xs):
+        shards = [
+            jax.device_put(x[None, ...], d) for x, d in zip(xs, devices)
+        ]
+        return jax.make_array_from_single_device_arrays(
+            (n,) + tuple(np.shape(xs[0])), sharding, shards
+        )
+
+    return jax.tree.map(build, *slot_batches)
+
+
+def assemble_over_mesh(producer, schema: Schema, mesh
+                       ) -> Tuple[ColumnBatch, int]:
+    """Execute ``producer`` with each partition pinned to a mesh device
+    (round-robin) and lay the output over the mesh: per-slot dictionary
+    remap + concat + compaction all run as device gathers; only live-row
+    counts sync to host. Producers with fewer partitions than devices
+    are ROW-split instead (device-side window slices of the compacted
+    whole), so a 1-partition dim-table scan doesn't put every row in one
+    slot and inflate the uniform capacity n_dev-fold.
+    Returns (stacked batch, per-device capacity)."""
+    devices = list(mesh.devices.flat)
+    n_dev = len(devices)
+    nparts = producer.output_partitioning().num_partitions
+    row_split = nparts < n_dev
+    slots: List[List[ColumnBatch]] = [[] for _ in range(n_dev)]
+    for p in range(nparts):
+        if row_split:
+            slots[p % n_dev].extend(producer.execute(p))
+        else:
+            with jax.default_device(devices[p % n_dev]):
+                for b in producer.execute(p):
+                    slots[p % n_dev].append(b)
+    for s in slots:
+        if not s:
+            s.append(empty_batch(schema))
+
+    flat = [b for s in slots for b in s]
+    dicts, remap_rows = _union_dicts(schema, flat)
+
+    from .base import concat_batches
+
+    slot_bigs: List[ColumnBatch] = []
+    i = 0
+    for s in slots:
+        rows = remap_rows[i : i + len(s)]
+        i += len(s)
+        remapped = [
+            _apply_remaps(schema, b, r, dicts) for b, r in zip(s, rows)
+        ]
+        big = (remapped[0] if len(remapped) == 1
+               else concat_batches(schema, remapped))
+        slot_bigs.append(big)
+
+    STATS["slot_assemblies"] += 1
+    if row_split:
+        big = (slot_bigs[0] if len(slot_bigs) == 1
+               else concat_batches(schema, slot_bigs))
+        n = int(big.num_rows)  # scalar sync only
+        cap = round_capacity(max(-(-n // n_dev), 1))
+        packed = _compact_to(big, cap=n_dev * cap)
+        slot_batches = [
+            _window_slot(packed, d * cap, cap,
+                         min(max(n - d * cap, 0), cap))
+            for d in range(n_dev)
+        ]
+        return stack_to_mesh(slot_batches, mesh), cap
+
+    counts = [int(b.num_rows) for b in slot_bigs]  # scalar syncs only
+    cap = round_capacity(max(max(counts), 1))
+    slot_batches = [_compact_to(b, cap=cap) for b in slot_bigs]
+    return stack_to_mesh(slot_batches, mesh), cap
+
+
+def _window_slot(packed: ColumnBatch, start: int, cap: int,
+                 count: int) -> ColumnBatch:
+    """Rows [start, start+cap) of a front-compacted batch as a slot batch
+    (device-side slices; ``count`` live rows at the front)."""
+    cols = [
+        Column(c.values[start : start + cap], c.dtype,
+               (c.validity[start : start + cap]
+                if c.validity is not None
+                else jnp.ones((cap,), jnp.bool_)),
+               c.dictionary)
+        for c in packed.columns
+    ]
+    live = packed.selection[start : start + cap]
+    return ColumnBatch(packed.schema, cols, live,
+                       jnp.asarray(np.int32(count)))
+
+
+# ---------------------------------------------------------------------------
+# HBM chaining: fused producer -> fused consumer without leaving the mesh
+# ---------------------------------------------------------------------------
+
+
+from collections import OrderedDict
+
+# bounded: treedef keys hold identity-hashed per-query Dictionary objects,
+# so an unbounded cache would pin executables + dictionaries forever
+_STACKED_COMPACT_JITS: OrderedDict = OrderedDict()
+_STACKED_COMPACT_CAP = 32
+
+
+def _maybe_compact_stacked(stacked: ColumnBatch, mesh,
+                           shrink_factor: int = 4) -> ColumnBatch:
+    """Shrink a sparse stacked batch with one per-device SPMD compaction
+    (costs a host sync on the [n_dev] live counts — int32s, not data)."""
+    counts = np.asarray(stacked.num_rows)
+    cap = int(stacked.selection.shape[1])
+    new_cap = max(round_capacity(int(counts.max(initial=0))), 8)
+    if new_cap * shrink_factor > cap:
+        return stacked
+    axis = mesh.axis_names[0]
+    key = (mesh, cap, new_cap, jax.tree.structure(stacked))
+    if key in _STACKED_COMPACT_JITS:
+        _STACKED_COMPACT_JITS.move_to_end(key)
+    else:
+        while len(_STACKED_COMPACT_JITS) >= _STACKED_COMPACT_CAP:
+            _STACKED_COMPACT_JITS.popitem(last=False)
+
+        @partial(shard_map, mesh=mesh, in_specs=(P(axis),),
+                 out_specs=P(axis), check_vma=False)
+        def run(st):
+            b = jax.tree.map(lambda x: x[0], st)
+            out = _compact_impl(b, new_cap)
+            return jax.tree.map(lambda x: x[None], out)
+
+        _STACKED_COMPACT_JITS[key] = jax.jit(run)
+    return _STACKED_COMPACT_JITS[key](stacked)
+
+
+def _chain_pipeline(plan, chain, inner: ColumnBatch, mesh) -> ColumnBatch:
+    """Apply a fused PipelineOp chain per device over a stacked input."""
+    axis = mesh.axis_names[0]
+    cache = plan.__dict__.setdefault("_stacked_jit", {})
+    key = (mesh, int(inner.selection.shape[1]))
+    if key not in cache:
+
+        @partial(shard_map, mesh=mesh, in_specs=(P(axis),),
+                 out_specs=P(axis), check_vma=False)
+        def run(st):
+            b = jax.tree.map(lambda x: x[0], st)
+            for op in chain:
+                b = op.device_transform(b)
+            return jax.tree.map(lambda x: x[None], b)
+
+        cache[key] = jax.jit(run)
+    return cache[key](inner)
+
+
+def _chain_partial_agg(agg, inner: ColumnBatch, mesh) -> ColumnBatch:
+    """Run a partial HashAggregate per device over a stacked input
+    (adaptive group capacity with whole-SPMD retry, like the final
+    aggregate inside MeshAggExec)."""
+    axis = mesh.axis_names[0]
+    in_cap = int(inner.selection.shape[1])
+    cache = agg.__dict__.setdefault("_stacked_jit", {})
+    cap = agg.group_capacity
+    while True:
+        key = (mesh, in_cap, cap)
+        if key not in cache:
+            fn = agg._get_grouped_fn(cap, in_cap)
+
+            @partial(shard_map, mesh=mesh, in_specs=(P(axis),),
+                     out_specs=(P(axis), P(axis)), check_vma=False)
+            def run(st):
+                b = jax.tree.map(lambda x: x[0], st)
+                out, ng = fn(b)
+                return jax.tree.map(lambda x: x[None], out), ng[None]
+
+            cache[key] = jax.jit(run)
+        out_stacked, ngs = cache[key](inner)
+        ng = int(np.max(np.asarray(ngs)))
+        if ng <= cap:
+            return out_stacked
+        cap = round_capacity(ng)
+
+
+def _try_chain(plan, mesh) -> Optional[ColumnBatch]:
+    """Stacked per-device output for plans rooted in a mesh-fused
+    operator (possibly under projection/filter/partial-agg wrappers), or
+    None when the plan must be assembled from host-driven partitions."""
+    from .aggregate import HashAggregateExec
+    from .base import PipelineOp
+    from .mesh_agg import MeshAggExec, MeshJoinExec
+
+    n_dev = mesh.devices.size
+    if isinstance(plan, (MeshAggExec, MeshJoinExec)):
+        if plan.n_devices != n_dev:
+            return None
+        return plan.execute_stacked(mesh)
+    if isinstance(plan, PipelineOp):
+        chain, source = plan._pipeline_chain()
+        inner = _try_chain(source, mesh)
+        if inner is None:
+            return None
+        return _chain_pipeline(plan, chain, inner, mesh)
+    if isinstance(plan, HashAggregateExec) and plan.mode == "partial" \
+            and plan.group_exprs:
+        inner = _try_chain(plan.child, mesh)
+        if inner is None:
+            return None
+        return _chain_partial_agg(plan, inner, mesh)
+    return None
+
+
+def stacked_input(producer, schema: Schema, mesh) -> Tuple[ColumnBatch, int]:
+    """The mesh-fused operator input contract: ``producer``'s rows as a
+    stacked [n_dev, cap] ColumnBatch sharded over the mesh, + cap.
+    Chains HBM-resident when the producer is itself mesh-fused; never
+    round-trips row data through host either way."""
+    chained = _try_chain(producer, mesh)
+    if chained is not None:
+        STATS["chained_stages"] += 1
+        chained = _maybe_compact_stacked(chained, mesh)
+        return chained, int(chained.selection.shape[1])
+    return assemble_over_mesh(producer, schema, mesh)

@@ -398,6 +398,7 @@ def physical_to_proto(plan) -> pb.PhysicalPlanNode:
             o.right_col = r
         n.mesh_join.how = plan.how
         n.mesh_join.n_devices = plan.n_devices
+        n.mesh_join.null_aware = plan.null_aware
     elif isinstance(plan, MeshAggExec):
         n.mesh_agg.producer.CopyFrom(physical_to_proto(plan.producer))
         for e in plan.group_exprs:
@@ -486,6 +487,7 @@ def physical_from_proto(n: pb.PhysicalPlanNode):
             [(o.left_col, o.right_col) for o in n.mesh_join.on],
             n.mesh_join.how,
             n.mesh_join.n_devices,
+            null_aware=n.mesh_join.null_aware,
         )
     if kind == "mesh_agg":
         from .physical.aggregate import DEFAULT_GROUP_CAPACITY
