@@ -14,8 +14,9 @@ ready-queue is per-process (the reference achieves active-active only by
 re-scanning every task under the global etcd lock on each poll —
 state/mod.rs:182-260 — the very pattern this engine replaced for
 scalability). The distributed lock below serves takeover/maintenance
-sections, and critical sections must stay under the lock lease TTL
-(no keepalive stream is implemented).
+sections; while held, a background LeaseKeepAlive stream renews the
+lease so critical sections may exceed the TTL, and a keepalive failure
+fails the section loudly instead of silently losing mutual exclusion.
 
 No etcd binary ships in this environment, so tests run against
 ``FakeEtcdServer`` — an in-process implementation of the same four
@@ -82,6 +83,11 @@ class EtcdBackend(KvBackend):
         self._delete = stub(_KV, "DeleteRange", epb.DeleteRangeResponse)
         self._grant = stub(_LEASE, "LeaseGrant", epb.LeaseGrantResponse)
         self._revoke = stub(_LEASE, "LeaseRevoke", epb.LeaseRevokeResponse)
+        self._keepalive = self.channel.stream_stream(
+            f"/{_LEASE}/LeaseKeepAlive",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=epb.LeaseKeepAliveResponse.FromString,
+        )
         self._lock = stub(_LOCK, "Lock", epb.LockResponse)
         self._unlock = stub(_LOCK, "Unlock", epb.UnlockResponse)
 
@@ -126,14 +132,37 @@ class EtcdBackend(KvBackend):
         self._delete(epb.DeleteRangeRequest(key=key.encode()))
 
     def lock(self):
+        """Distributed lock whose lease is KEPT ALIVE while held: a
+        background thread runs the LeaseKeepAlive stream so critical
+        sections longer than the TTL don't silently lose mutual
+        exclusion. If the keepalive cannot reach etcd (or etcd reports
+        the lease gone), mutual exclusion is no longer guaranteed — the
+        section fails LOUDLY: ``held()`` flips False and ``__exit__``
+        raises ClusterError instead of pretending the work was safe."""
         backend = self
 
         class _DistributedLock:
+            def held(self_inner) -> bool:
+                """True while mutual exclusion is still guaranteed. A
+                keepalive ACK older than the TTL counts as lost even if
+                the stream hasn't errored — a black-holed connection
+                blocks in the read for TCP-retransmit timescales while
+                the lease expires server-side."""
+                if self_inner._lost.is_set():
+                    return False
+                if time.time() - self_inner._last_ack[0] > backend._lock_ttl:
+                    self_inner._lost.set()
+                    return False
+                return True
+
             def __enter__(self_inner):
                 lease = backend._grant(
                     epb.LeaseGrantRequest(TTL=backend._lock_ttl)
                 ).ID
                 self_inner._lease = lease
+                self_inner._stop = threading.Event()
+                self_inner._lost = threading.Event()
+                self_inner._last_ack = [time.time()]
                 try:
                     self_inner._key = backend._lock(
                         epb.LockRequest(name=LOCK_NAME, lease=lease)
@@ -141,11 +170,55 @@ class EtcdBackend(KvBackend):
                 except Exception:
                     backend._revoke(epb.LeaseRevokeRequest(ID=lease))
                     raise
+                self_inner._last_ack[0] = time.time()
+                interval = max(backend._lock_ttl / 3.0, 0.5)
+
+                def keepalive():
+                    stop = self_inner._stop
+
+                    def requests():
+                        while not stop.is_set():
+                            yield epb.LeaseKeepAliveRequest(ID=lease)
+                            stop.wait(interval)
+
+                    try:
+                        for resp in backend._keepalive(requests()):
+                            if stop.is_set():
+                                return
+                            if resp.TTL <= 0:  # etcd: lease is gone
+                                self_inner._lost.set()
+                                return
+                            self_inner._last_ack[0] = time.time()
+                    except Exception:  # noqa: BLE001 - stream died
+                        if not stop.is_set():
+                            self_inner._lost.set()
+
+                self_inner._ka = threading.Thread(
+                    target=keepalive, daemon=True, name="etcd-lock-keepalive"
+                )
+                self_inner._ka.start()
                 return self_inner
 
             def __exit__(self_inner, *exc):
-                backend._unlock(epb.UnlockRequest(key=self_inner._key))
-                backend._revoke(epb.LeaseRevokeRequest(ID=self_inner._lease))
+                still_held = self_inner.held()  # evaluate BEFORE teardown
+                self_inner._stop.set()
+                try:
+                    backend._unlock(epb.UnlockRequest(key=self_inner._key),
+                                    timeout=5.0)
+                    backend._revoke(
+                        epb.LeaseRevokeRequest(ID=self_inner._lease),
+                        timeout=5.0)
+                except Exception:  # noqa: BLE001 - etcd may be gone
+                    pass
+                self_inner._ka.join(timeout=2.0)
+                if not still_held and exc == (None, None, None):
+                    from ..errors import ClusterError
+
+                    raise ClusterError(
+                        "etcd lock lease was lost while held (keepalive "
+                        "failed or TTL expired): the critical section ran "
+                        "WITHOUT mutual exclusion and must not be trusted"
+                    )
                 return False
 
         return _DistributedLock()
@@ -163,6 +236,7 @@ class _FakeEtcdState:
     def __init__(self):
         self.kv: Dict[bytes, Tuple[bytes, int]] = {}  # key -> (value, lease)
         self.leases: Dict[int, float] = {}  # id -> expiry
+        self.lease_ttls: Dict[int, int] = {}  # id -> granted TTL (keepalive)
         self.next_lease = 1
         self.mu = threading.Lock()
         self.lock_mu = threading.Lock()  # the global lock itself
@@ -224,6 +298,7 @@ class FakeEtcdServer:
                 lid = req.ID or st.next_lease
                 st.next_lease = max(st.next_lease, lid) + 1
                 st.leases[lid] = time.time() + req.TTL
+                st.lease_ttls[lid] = req.TTL
             return epb.LeaseGrantResponse(ID=lid, TTL=req.TTL)
 
         def LeaseRevoke(req: epb.LeaseRevokeRequest, ctx=None):
@@ -233,6 +308,21 @@ class FakeEtcdServer:
                 for k in doomed:
                     del st.kv[k]
             return epb.LeaseRevokeResponse()
+
+        def LeaseKeepAlive(request_iterator, ctx=None):
+            # etcd semantics: each ping extends an ALIVE lease to its
+            # original TTL; a dead/unknown lease answers TTL=0.
+            # (yield OUTSIDE the lock: a stalled stream consumer must
+            # not pin st.mu across generator suspension)
+            for req in request_iterator:
+                with st.mu:
+                    ttl = st.lease_ttls.get(req.ID)
+                    if ttl is not None and st.alive(req.ID):
+                        st.leases[req.ID] = time.time() + ttl
+                        resp = epb.LeaseKeepAliveResponse(ID=req.ID, TTL=ttl)
+                    else:
+                        resp = epb.LeaseKeepAliveResponse(ID=req.ID, TTL=0)
+                yield resp
 
         def Lock(req: epb.LockRequest, ctx=None):
             st.lock_mu.acquire()
@@ -264,6 +354,12 @@ class FakeEtcdServer:
                 )
                 for name, (fn, req_t) in methods.items()
             }
+            if service == _LEASE:
+                handlers["LeaseKeepAlive"] = grpc.stream_stream_rpc_method_handler(
+                    LeaseKeepAlive,
+                    request_deserializer=epb.LeaseKeepAliveRequest.FromString,
+                    response_serializer=lambda m: m.SerializeToString(),
+                )
             self.server.add_generic_rpc_handlers(
                 (grpc.method_handlers_generic_handler(service, handlers),)
             )

@@ -36,12 +36,33 @@ _KIND_CODES = {
 }
 
 
+def _try_build() -> bool:
+    """Build the shared library on first use if a toolchain is present.
+
+    The .so is not checked in, so a fresh checkout (or the driver's bench
+    run) would otherwise silently fall back to the pandas reader and
+    report a parse-bound cold path."""
+    import shutil
+    import subprocess
+
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.dirname(_LIB_PATH)],
+            capture_output=True, timeout=120, check=True,
+        )
+    except Exception:  # noqa: BLE001 - build is best-effort
+        return False
+    return os.path.exists(_LIB_PATH)
+
+
 def _load():
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
+        if not os.path.exists(_LIB_PATH) and not _try_build():
             return None
         lib = ctypes.CDLL(_LIB_PATH)
         lib.tbl_open.restype = ctypes.c_void_p

@@ -6,16 +6,30 @@ kernel so the row -> group scatter never materializes [N, G] masks in
 HBM (the role a hand-written Rust hash-aggregate loop plays in the
 reference's DataFusion engine; here it is a TPU kernel, not CPU code).
 
-Exactness without i64 vectors: Mosaic has no 64-bit vector ops, so each
-scaled-decimal int64 value is split into three limbs (16+16+32-bit,
-arithmetic shift keeps the sign in the top limb) and accumulated in
-int32 per block — safe because a block's limb sum is bounded by
-BLOCK * 2^16 < 2^31 — then the per-block partials are recombined in
-int64 by XLA: sum(v) = sum(l0) + (sum(l1) << 16) + (sum(l2) << 32).
-Values must fit |v| < 2^47 (checked by the caller's decimal scales).
+Formulation: per grid block, rows are one-hot encoded by group id and
+the per-group partial sums are ONE matmul on the MXU:
 
-Developed and tested in interpret mode (no TPU in CI); enable on-chip
-via BALLISTA_PALLAS=1 once measured (kernels/aggregate.py gates it).
+    acc[G, C] = onehot[BLOCK, G]^T @ limbs[BLOCK, C]
+
+(the round-2 kernel statically unrolled a masked VPU reduction per
+group — fine for q1's 4 groups, pathological compile time and code size
+at G=256; the matmul form is O(1) in G).
+
+Exactness without i64 vectors: Mosaic has no 64-bit vector ops and the
+MXU accumulates in float32, so each int64 value is split into FIVE
+13-bit limbs (arithmetic shift keeps the sign in the top limb), which
+covers the ENTIRE int64 range — no caller-side magnitude precondition.
+A block's per-limb group sum is bounded by BLOCK * 2^13 = 2^23 < 2^24,
+so every partial is exactly representable in f32; the per-block int32
+partials are recombined in int64 by XLA:
+sum(v) = sum(l0) + (sum(l1) << 13) + ... + (sum(l4) << 52).
+
+Validity-masked aggregates: the caller pre-zeroes masked-out values
+(sum semantics) and passes each COUNT's 0/1 mask as one more value
+column, so the kernel itself only ever sums.
+
+Developed and validated in interpret mode (no TPU in CI);
+kernels/aggregate.py turns it on automatically on real TPU hardware.
 """
 
 from __future__ import annotations
@@ -26,78 +40,95 @@ from typing import List, Sequence
 import jax
 import jax.numpy as jnp
 
-BLOCK = 1024  # rows per grid step; limb sums stay < 2^31
+BLOCK = 1024  # rows per grid step; per-limb block sums stay < 2^24 (f32-exact)
+LIMB_BITS = 13
+N_LIMBS = 5  # 4x13 bits + signed top limb (v>>52): all of int64
 
 
 def _limbs(v: jax.Array) -> List[jax.Array]:
-    """int64 [N] -> three int32 [N] limbs (16/16/32, sign in the top)."""
-    l0 = (v & jnp.int64(0xFFFF)).astype(jnp.int32)
-    l1 = ((v >> 16) & jnp.int64(0xFFFF)).astype(jnp.int32)
-    l2 = (v >> 32).astype(jnp.int32)  # arithmetic shift: carries the sign
-    return [l0, l1, l2]
+    """int64 [N] -> four int32 13-bit limbs (sign rides the top limb via
+    arithmetic shift)."""
+    mask = jnp.int64((1 << LIMB_BITS) - 1)
+    out = []
+    for i in range(N_LIMBS - 1):
+        out.append(((v >> (LIMB_BITS * i)) & mask).astype(jnp.int32))
+    out.append((v >> (LIMB_BITS * (N_LIMBS - 1))).astype(jnp.int32))
+    return out
 
 
-def _kernel(gid_ref, live_ref, limb_ref, out_ref, *, num_groups: int,
-            n_cols: int):
-    """One grid step: accumulate this block's rows into per-group
-    partial sums. out block: [1, num_groups, n_cols + 1] int32 (the last
-    column counts live rows)."""
-    gids = gid_ref[...]  # [BLOCK] int32
-    live = live_ref[...]  # [BLOCK] int32 (0/1)
-    limbs = limb_ref[...]  # [BLOCK, n_cols] int32
-    for g in range(num_groups):  # static unroll: VPU masked reductions
-        mask = jnp.logical_and(gids == g, live > 0)
-        masked = jnp.where(mask[:, None], limbs, 0)
-        out_ref[0, g, :n_cols] = jnp.sum(masked, axis=0)
-        out_ref[0, g, n_cols] = jnp.sum(mask.astype(jnp.int32))
+def _kernel(gid_ref, limb_ref, out_ref, *, num_groups: int):
+    """One grid step: one-hot the block's group ids and matmul the limb
+    matrix onto the MXU. Dead rows carry gid == -1 (never one-hot)."""
+    gids = gid_ref[...]  # [BLOCK] int32; -1 = dead
+    limbs = limb_ref[...].astype(jnp.float32)  # [BLOCK, C], all < 2^13
+    groups = jax.lax.broadcasted_iota(jnp.int32, (BLOCK, num_groups), 1)
+    oh = (gids[:, None] == groups).astype(jnp.float32)  # [BLOCK, G]
+    acc = jax.lax.dot_general(
+        oh, limbs, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [G, C] — exact: every partial < 2^24
+    out_ref[0] = acc.astype(jnp.int32)
 
 
 def dense_grouped_sums(
     gids: jax.Array,  # int32 [N] in [0, num_groups)
     live: jax.Array,  # bool [N]
-    values: Sequence[jax.Array],  # int64 [N] each (|v| < 2^47)
+    values: Sequence[jax.Array],  # int64 [N] each (|v| < 2^51), pre-masked
     num_groups: int,
     interpret: bool = False,
 ):
-    """Returns (sums: list of int64 [G], counts: int64 [G])."""
+    """Returns (sums: list of int64 [G], counts: int64 [G]).
+
+    ``values`` are summed per group; ``counts`` counts live rows. Callers
+    wanting validity-masked counts pass the mask as a value column.
+    """
     from jax.experimental import pallas as pl
 
-    if not values:
-        raise ValueError("dense_grouped_sums needs at least one value column")
     n = gids.shape[0]
+    # dead rows -> group -1: never matches the one-hot iota
+    gids = jnp.where(live, gids, -1).astype(jnp.int32)
+    ones = live.astype(jnp.int64)
+    cols: List[jax.Array] = []
+    for v in values:
+        cols.extend(_limbs(v))
+    cols.append(ones)  # count column (exact: 0/1)
+    n_cols = len(cols)
+
     pad = (-n) % BLOCK
     if pad:
-        gids = jnp.pad(gids, (0, pad))
-        live = jnp.pad(live, (0, pad))
-        values = [jnp.pad(v, (0, pad)) for v in values]
+        gids = jnp.pad(gids, (0, pad), constant_values=-1)
+        cols = [jnp.pad(c, (0, pad)) for c in cols]
         n += pad
     n_blocks = n // BLOCK
-    n_cols = 3 * len(values)
-    limbs = jnp.stack([l for v in values for l in _limbs(v)], axis=1)
+    limbs = jnp.stack(cols, axis=1).astype(jnp.int32)
 
+    # index-map constants must be constructed int32 INSIDE the lambda:
+    # the engine enables jax_enable_x64 globally, so a bare `0` traces
+    # as i64 (Mosaic rejects i64 block indices), and a hoisted Array is
+    # rejected as a captured constant
     partials = pl.pallas_call(
-        partial(_kernel, num_groups=num_groups, n_cols=n_cols),
+        partial(_kernel, num_groups=num_groups),
         grid=(n_blocks,),
         in_specs=[
             pl.BlockSpec((BLOCK,), lambda b: (b,)),
-            pl.BlockSpec((BLOCK,), lambda b: (b,)),
-            pl.BlockSpec((BLOCK, n_cols), lambda b: (b, 0)),
+            pl.BlockSpec((BLOCK, n_cols), lambda b: (b, jnp.int32(0))),
         ],
         out_specs=pl.BlockSpec(
-            (1, num_groups, n_cols + 1), lambda b: (b, 0, 0)
+            (1, num_groups, n_cols),
+            lambda b: (b, jnp.int32(0), jnp.int32(0)),
         ),
         out_shape=jax.ShapeDtypeStruct(
-            (n_blocks, num_groups, n_cols + 1), jnp.int32
+            (n_blocks, num_groups, n_cols), jnp.int32
         ),
         interpret=interpret,
-    )(gids, live.astype(jnp.int32), limbs)
+    )(gids, limbs)
 
-    totals = jnp.sum(partials.astype(jnp.int64), axis=0)  # [G, C+1]
+    totals = jnp.sum(partials.astype(jnp.int64), axis=0)  # [G, C]
     sums = []
     for i in range(len(values)):
-        l0 = totals[:, 3 * i]
-        l1 = totals[:, 3 * i + 1]
-        l2 = totals[:, 3 * i + 2]
-        sums.append(l0 + (l1 << 16) + (l2 << 32))
-    counts = totals[:, n_cols]
+        s = jnp.zeros((num_groups,), jnp.int64)
+        for j in range(N_LIMBS):
+            s = s + (totals[:, N_LIMBS * i + j] << (LIMB_BITS * j))
+        sums.append(s)
+    counts = totals[:, n_cols - 1]
     return sums, counts

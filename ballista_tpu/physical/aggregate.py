@@ -260,8 +260,41 @@ class HashAggregateExec(PhysicalPlan):
         return grouped_aggregate(keys, batch.selection, aggs, cap,
                                  key_validities)
 
+    def _static_group_bound(self, batch: ColumnBatch) -> Optional[int]:
+        """Host-side upper bound on the group count when every group key
+        is a plain column with known cardinality (dictionary/boolean) —
+        mirrors the dense-path condition in ``_run_grouping``. Lets
+        ``_exec_grouped`` skip the overflow-check device sync entirely:
+        a blocking device->host read costs a full round-trip when the
+        accelerator is remote."""
+        g = 1
+        for e in self.group_exprs:
+            if self.mode == "partial":
+                base = ex.strip_alias(e)
+                if not isinstance(base, ex.ColumnRef):
+                    return None
+                name = base.column
+            else:
+                name = e.name()
+            try:
+                col = batch.column(name)
+            except Exception:  # noqa: BLE001 - unknown column: no bound
+                return None
+            if col.dictionary is not None:
+                card = len(col.dictionary)
+            elif col.dtype.kind == "boolean":
+                card = 2
+            else:
+                return None
+            g *= card + (1 if col.validity is not None else 0)
+        return g if g > 0 else None
+
     def _exec_grouped(self, batch: ColumnBatch) -> ColumnBatch:
         cap = self.group_capacity
+        bound = self._static_group_bound(batch)
+        if bound is not None and bound <= min(DENSE_GROUP_LIMIT, cap):
+            out, _ng = self._get_grouped_fn(cap, batch.capacity)(batch)
+            return out  # dense path, can't overflow: no sync needed
         while True:
             fn = self._get_grouped_fn(cap, batch.capacity)
             out, num_groups = fn(batch)

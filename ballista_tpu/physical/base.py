@@ -184,17 +184,47 @@ def concat_batches(schema: Schema, batches: List[ColumnBatch]) -> ColumnBatch:
 
 _COMPACT_JITS: dict = {}
 
+# Measured cost of a blocking scalar device->host read (seconds). When the
+# accelerator is remote (e.g. tunneled), one sync costs a network
+# round-trip — far more than speculative compaction ever saves — so
+# maybe_compact only pays for a sync while syncs are known to be cheap.
+_SYNC_COST: List[float] = []
+_SYNC_COST_LIMIT = 0.005
 
-def maybe_compact(batch: ColumnBatch, shrink_factor: int = 4) -> ColumnBatch:
+
+def _record_sync_cost(batch: ColumnBatch) -> None:
+    """Measure a PURE round-trip: re-fetch a scalar that is already on
+    its way/ready, so pending compute doesn't inflate the figure."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    int(batch.num_rows)
+    _SYNC_COST.append(_time.perf_counter() - t0)
+
+
+def maybe_compact(batch: ColumnBatch, shrink_factor: int = 4,
+                  known_rows: Optional[int] = None) -> ColumnBatch:
     """Shrink a sparse batch: when live rows fill under 1/shrink_factor
     of the capacity, gather them to the front of a smaller batch. One
     sort+gather now buys every downstream operator a smaller shape —
-    decisive after selective joins/filters in long pipelines. Costs a
-    host sync on the live count; callers use it at operator boundaries
-    where a sync is already imminent."""
+    decisive after selective joins/filters in long pipelines.
+
+    Pass ``known_rows`` when the live count is already on host (e.g. the
+    join expand loop just synced its overflow check) — then this never
+    blocks. Without it, the live-count sync is only paid while measured
+    sync cost is low; on a remote accelerator the first call measures
+    the round-trip and all later speculative syncs are skipped."""
     from ..columnar import round_capacity
 
-    n = int(batch.num_rows)
+    if known_rows is not None:
+        n = known_rows
+    else:
+        if _SYNC_COST and _SYNC_COST[-1] > _SYNC_COST_LIMIT:
+            return batch  # a sync costs more than compaction saves
+        first = not _SYNC_COST
+        n = int(batch.num_rows)
+        if first:
+            _record_sync_cost(batch)  # pure-RTT measurement
     cap = batch.capacity
     new_cap = max(round_capacity(n), 8)
     if new_cap * shrink_factor > cap:

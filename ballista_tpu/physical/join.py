@@ -502,7 +502,9 @@ class JoinExec(PhysicalPlan):
             out_cap = round_capacity(t)
         from .base import maybe_compact
 
-        yield maybe_compact(out)
+        # the overflow check above already synced the match count, so
+        # compaction here never costs an extra round-trip
+        yield maybe_compact(out, known_rows=min(t, out_cap))
         if self.how in ("left", "full"):
             # preserved probe rows with no match, null build columns
             key = ("l", mode, pb.capacity, build_batch.capacity)

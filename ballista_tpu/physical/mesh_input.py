@@ -225,7 +225,10 @@ def assemble_over_mesh(producer, schema: Schema, mesh
         ]
         return stack_to_mesh(slot_batches, mesh), cap
 
-    counts = [int(b.num_rows) for b in slot_bigs]  # scalar syncs only
+    # ONE batched fetch for all slot counts: sequential int() reads
+    # would pay a device->host round-trip per device
+    counts = [int(c) for c in
+              jax.device_get([b.num_rows for b in slot_bigs])]
     cap = round_capacity(max(max(counts), 1))
     slot_batches = [_compact_to(b, cap=cap) for b in slot_bigs]
     return stack_to_mesh(slot_batches, mesh), cap

@@ -76,6 +76,46 @@ def test_etcd_distributed_lock_mutual_exclusion(etcd):
         assert order[i][1] == "in" and order[i + 1][1] == "out"
 
 
+def test_lock_keepalive_outlives_ttl():
+    """A critical section LONGER than the lock TTL keeps mutual
+    exclusion: the background LeaseKeepAlive stream renews the lease
+    (round 2 had no keepalive, so overruns silently unlocked)."""
+    server = FakeEtcdServer()
+    backend = EtcdBackend(f"localhost:{server.port}", lock_ttl_secs=1)
+    try:
+        with backend.lock() as lk:
+            time.sleep(2.2)  # > 2 TTLs
+            assert lk.held(), "keepalive failed to renew the lock lease"
+            # the lease key must still be alive server-side
+            assert server._st.alive(lk._lease)
+    finally:
+        backend.close()
+        server.stop()
+
+
+def test_lock_lost_lease_fails_loudly():
+    """If the lease dies while held (etcd unreachable / revoked), the
+    section must FAIL, not silently continue without mutual
+    exclusion."""
+    from ballista_tpu.errors import ClusterError
+    from ballista_tpu.proto import etcd_pb2 as epb
+
+    server = FakeEtcdServer()
+    backend = EtcdBackend(f"localhost:{server.port}", lock_ttl_secs=1)
+    try:
+        with pytest.raises(ClusterError, match="mutual exclusion"):
+            with backend.lock() as lk:
+                # simulate lease loss (e.g. etcd leader expired it)
+                backend._revoke(epb.LeaseRevokeRequest(ID=lk._lease))
+                deadline = time.time() + 3
+                while lk.held() and time.time() < deadline:
+                    time.sleep(0.05)
+                assert not lk.held(), "lost lease never detected"
+    finally:
+        backend.close()
+        server.stop()
+
+
 def test_scheduler_state_over_etcd(etcd):
     """The whole state machine runs against the etcd wire protocol."""
     state = SchedulerState(etcd, "ha")

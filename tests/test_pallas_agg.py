@@ -40,6 +40,79 @@ def test_empty_group_and_all_dead():
     assert [int(x) for x in counts] == [1, 0, 1, 0]
 
 
+def test_kernel_exact_g256_with_validity_masks():
+    """G=256 (the dense-path ceiling) with validity-masked sums and
+    counts: the one-hot matmul formulation must stay exact — the round-2
+    kernel statically unrolled per group and rejected masked inputs."""
+    from ballista_tpu.kernels.aggregate import (
+        AggInput, dense_grouped_aggregate,
+    )
+
+    rng = np.random.default_rng(9)
+    n, G = 2048 + 33, 256
+    gids = rng.integers(0, G, n).astype(np.int32)
+    live = rng.random(n) < 0.8
+    v1 = rng.integers(-(1 << 49), 1 << 49, n)  # 4x13-bit limb headroom
+    v2 = rng.integers(0, 10**9, n)
+    valid1 = rng.random(n) < 0.6
+    import os
+
+    os.environ["BALLISTA_PALLAS"] = "interpret"
+    try:
+        res = dense_grouped_aggregate(
+            jnp.asarray(gids), jnp.asarray(live),
+            [
+                AggInput("sum", jnp.asarray(v1), jnp.asarray(valid1)),
+                AggInput("sum", jnp.asarray(v2), None),
+                AggInput("count", None, jnp.asarray(valid1)),
+                AggInput("count", None, None),
+                # min stays on the XLA dense path, split per aggregate
+                AggInput("min", jnp.asarray(v2), None),
+            ],
+            G,
+        )
+    finally:
+        del os.environ["BALLISTA_PALLAS"]
+    for g in range(0, G, 17):
+        m = live & (gids == g)
+        mv = m & valid1
+        assert int(res.aggregates[0][g]) == int(v1[mv].sum())
+        assert bool(res.agg_valid[0][g]) == bool(mv.any())
+        assert int(res.aggregates[1][g]) == int(v2[m].sum())
+        assert int(res.aggregates[2][g]) == int(mv.sum())
+        assert int(res.aggregates[3][g]) == int(m.sum())
+        if m.any():
+            assert int(res.aggregates[4][g]) == int(v2[m].min())
+
+
+def test_auto_gate_small_cpu_batches_use_interpret(monkeypatch):
+    """With no env set, small CPU batches route through the kernel in
+    interpret mode automatically (the gate flips to the compiled kernel
+    on real TPU hardware)."""
+    monkeypatch.delenv("BALLISTA_PALLAS", raising=False)
+    from ballista_tpu.kernels import aggregate as agg_mod
+    from ballista_tpu.kernels.aggregate import (
+        AggInput, dense_grouped_aggregate,
+    )
+
+    calls = {}
+    orig = agg_mod._dense_grouped_pallas
+
+    def spy(gids, live, aggs, num_groups, interpret):
+        calls["interpret"] = interpret
+        return orig(gids, live, aggs, num_groups, interpret)
+
+    monkeypatch.setattr(agg_mod, "_dense_grouped_pallas", spy)
+    gids = jnp.asarray(np.array([0, 1, 1, 2], np.int32))
+    live = jnp.ones(4, bool)
+    res = dense_grouped_aggregate(
+        gids, live, [AggInput("sum", jnp.arange(4, dtype=jnp.int64), None)],
+        4,
+    )
+    assert calls.get("interpret") is True
+    assert [int(x) for x in res.aggregates[0][:3]] == [0, 3, 3]
+
+
 def test_q1_through_pallas_interpret(tmp_path, monkeypatch):
     """TPC-H q1 with the dense path routed through the Pallas kernel
     matches the oracle end to end."""
