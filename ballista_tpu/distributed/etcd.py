@@ -163,14 +163,6 @@ class EtcdBackend(KvBackend):
                 self_inner._stop = threading.Event()
                 self_inner._lost = threading.Event()
                 self_inner._last_ack = [time.time()]
-                try:
-                    self_inner._key = backend._lock(
-                        epb.LockRequest(name=LOCK_NAME, lease=lease)
-                    ).key
-                except Exception:
-                    backend._revoke(epb.LeaseRevokeRequest(ID=lease))
-                    raise
-                self_inner._last_ack[0] = time.time()
                 interval = max(backend._lock_ttl / 3.0, 0.5)
 
                 def keepalive():
@@ -193,10 +185,23 @@ class EtcdBackend(KvBackend):
                         if not stop.is_set():
                             self_inner._lost.set()
 
+                # keepalive starts BEFORE the Lock RPC: a contended
+                # acquisition can wait behind the current holder for
+                # longer than the TTL, and the lease must survive the
+                # wait or etcd fails/poisons the acquisition
                 self_inner._ka = threading.Thread(
                     target=keepalive, daemon=True, name="etcd-lock-keepalive"
                 )
                 self_inner._ka.start()
+                try:
+                    self_inner._key = backend._lock(
+                        epb.LockRequest(name=LOCK_NAME, lease=lease)
+                    ).key
+                except Exception:
+                    self_inner._stop.set()
+                    backend._revoke(epb.LeaseRevokeRequest(ID=lease))
+                    raise
+                self_inner._last_ack[0] = time.time()
                 return self_inner
 
             def __exit__(self_inner, *exc):

@@ -22,6 +22,7 @@ DEFAULTS = {
     "sqlite_path": "ballista-state.db",
     "etcd_urls": "localhost:2379",
     "speculation_secs": 60,  # duplicate stragglers after this; 0 = off
+    "flight_port": -1,  # Arrow Flight SQL front-end; -1 = off, 0 = ephemeral
     "log_level": "INFO",
 }
 
@@ -67,8 +68,44 @@ def main(argv=None) -> int:
     print(f"ballista-tpu scheduler listening on {cfg['bind_host']}:{port} "
           f"(backend={cfg['config_backend']}, ns={cfg['namespace']})",
           flush=True)
+    flight_server = None
+    if int(cfg["flight_port"]) >= 0:
+        # Arrow Flight front-end: foreign clients (the reference's JDBC
+        # driver shape — jdbc:arrow://host:flight_port) send raw SQL as
+        # a DoGet ticket; the query runs through the NORMAL cluster path
+        # (submit -> schedule -> executors -> fetch) via a loopback
+        # client context
+        from ..client import BallistaContext
+        from .flight import available as flight_available, serve_flight
+
+        if not flight_available():
+            ap.error("--flight-port requires pyarrow.flight")
+        # loopback target: a wildcard/loopback bind is reachable via
+        # 127.0.0.1; a specific interface is only reachable at that addr
+        loop_host = ("127.0.0.1"
+                     if cfg["bind_host"] in ("0.0.0.0", "::", "localhost",
+                                             "127.0.0.1")
+                     else cfg["bind_host"])
+        fctx = BallistaContext.remote(loop_host, port)
+
+        def execute_sql(sql):
+            df = fctx.sql(sql)
+            if df._plan is None and df._raw_sql is None:  # DDL: CREATE
+                import numpy as np  # EXTERNAL TABLE registered above
+
+                return {"status": np.asarray(["OK"], dtype=object)}
+            return df.collect()
+
+        flight_server, fport = serve_flight(
+            cfg["bind_host"], int(cfg["flight_port"]),
+            execute_sql=execute_sql,
+        )
+        print(f"ballista-tpu Arrow Flight SQL endpoint on "
+              f"{cfg['bind_host']}:{fport}", flush=True)
     stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
     print(f"signal {stop}; shutting down", flush=True)
+    if flight_server is not None:
+        flight_server.shutdown()
     server.stop(grace=2)
     return 0
 

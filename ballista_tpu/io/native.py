@@ -41,17 +41,33 @@ def _try_build() -> bool:
 
     The .so is not checked in, so a fresh checkout (or the driver's bench
     run) would otherwise silently fall back to the pandas reader and
-    report a parse-bound cold path."""
+    report a parse-bound cold path. Cross-PROCESS builds (several
+    executors sharing a checkout) serialize on an flock'd lock file so
+    one g++ never rewrites the .so another process is dlopen()ing."""
     import shutil
     import subprocess
+    import sys
 
     if shutil.which("make") is None or shutil.which("g++") is None:
         return False
+    native_dir = os.path.dirname(_LIB_PATH)
+    lockfile = os.path.join(native_dir, ".buildlock")
     try:
-        subprocess.run(
-            ["make", "-C", os.path.dirname(_LIB_PATH)],
-            capture_output=True, timeout=120, check=True,
-        )
+        import fcntl
+
+        with open(lockfile, "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            try:
+                if os.path.exists(_LIB_PATH):  # another process built it
+                    return True
+                print("ballista_tpu: building native scanner "
+                      f"({native_dir})...", file=sys.stderr)
+                subprocess.run(
+                    ["make", "-C", native_dir],
+                    capture_output=True, timeout=120, check=True,
+                )
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)
     except Exception:  # noqa: BLE001 - build is best-effort
         return False
     return os.path.exists(_LIB_PATH)

@@ -207,24 +207,38 @@ _PALLAS_INTERPRET_MAX_ROWS = 4096
 def _pallas_mode() -> str:
     """'' (off) | 'on' (compiled kernel) | 'interpret' | 'auto'.
 
-    Default (no env): interpret-mode for small CI batches on CPU (keeps
-    the kernel exactness-tested in every run), OFF on real TPU — the
-    round-3 on-chip A/B (BENCH_r03) measured the XLA dense path at ~4x
-    the Pallas one-hot matmul for q1's tiny group counts (G<=8 leaves
-    the MXU idle and the limb split adds ~7x HBM traffic), so the
-    compiled kernel stays opt-in via ``BALLISTA_PALLAS=on`` until a
-    shape class wins. bench.py records the A/B automatically each run.
+    Default (no env): OFF everywhere in production — the round-3
+    on-chip A/B (recorded in bench.py's JSON every run) measured the XLA
+    dense path at ~4x the Pallas one-hot matmul for q1's tiny group
+    counts (G<=8 leaves the MXU idle and the limb split adds ~7x HBM
+    traffic), and interpret mode is a python loop nobody should pay
+    outside tests. Under pytest, small CPU batches auto-route through
+    interpret mode so the kernel stays exactness-tested in every run.
+    Explicit ``BALLISTA_PALLAS`` (off/on/interpret) always wins; an
+    unrecognized value warns once and means off.
     """
     import os
 
     env = os.environ.get("BALLISTA_PALLAS", "").lower()
+    if not env:
+        return "auto"
     if env in ("off", "0", "no", "false"):
         return ""
     if env in ("on", "1", "yes", "true"):
         return "on"
     if env == "interpret":
         return "interpret"
-    return "auto"
+    if env not in _warned_env:
+        import logging
+
+        logging.getLogger("ballista.kernels").warning(
+            "unrecognized BALLISTA_PALLAS=%r: treating as off "
+            "(expected off/on/interpret)", env)
+        _warned_env.append(env)
+    return ""
+
+
+_warned_env: list = []
 
 
 def _pallas_additive(a: AggInput) -> bool:
@@ -245,11 +259,14 @@ def dense_grouped_aggregate(
 ) -> GroupedResult:
     mode = _pallas_mode()
     if mode == "auto":
-        if jax.default_backend() == "cpu" and \
+        import os
+
+        if "PYTEST_CURRENT_TEST" in os.environ and \
+                jax.default_backend() == "cpu" and \
                 gids.shape[0] <= _PALLAS_INTERPRET_MAX_ROWS:
-            mode = "interpret"
+            mode = "interpret"  # CI: keep the kernel exactness-tested
         else:
-            mode = ""  # TPU default is XLA: measured faster (BENCH_r03)
+            mode = ""  # production default is XLA: measured faster
     if mode in ("on", "interpret"):
         additive = [a for a in aggs if _pallas_additive(a)]
         rest = [a for a in aggs if not _pallas_additive(a)]

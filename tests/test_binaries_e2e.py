@@ -77,3 +77,63 @@ def test_binaries_end_to_end(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_flight_frontend_against_real_cluster(tmp_path):
+    """A FOREIGN Arrow Flight client (stock pyarrow, no ballista code)
+    runs DDL + a query against the scheduler binary's --flight-port:
+    the reference JDBC driver's jdbc:arrow://host:port flow, end to end
+    through the real cluster (scheduler + executor processes)."""
+    paflight = pytest.importorskip("pyarrow.flight")
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = []
+    try:
+        sched = _spawn(["ballista_tpu.distributed.scheduler_main",
+                        "--bind-host", "localhost", "--port", "0",
+                        "--flight-port", "0"], env)
+        procs.append(sched)
+        line = sched.stdout.readline()
+        m = re.search(r"listening on [^:]+:(\d+)", line)
+        assert m, f"no port in scheduler output: {line!r}"
+        fline = sched.stdout.readline()
+        fm = re.search(r"Flight SQL endpoint on [^:]+:(\d+)", fline)
+        assert fm, f"no flight port in scheduler output: {fline!r}"
+        fport = int(fm.group(1))
+
+        e = _spawn(["ballista_tpu.distributed.executor_main",
+                    "--scheduler-host", "localhost",
+                    "--scheduler-port", m.group(1),
+                    "--work-dir", str(tmp_path / "w0"),
+                    "--num-devices", "1"], env)
+        procs.append(e)
+        assert "polling" in e.stdout.readline()
+
+        data = tmp_path / "t.tbl"
+        data.write_text("".join(f"{i}|k{i % 3}|\n" for i in range(60)))
+
+        client = paflight.connect(f"grpc://localhost:{fport}")
+        ddl = (f"CREATE EXTERNAL TABLE t (a BIGINT, c VARCHAR) "
+               f"STORED AS TBL LOCATION '{data}'")
+        status = client.do_get(paflight.Ticket(ddl.encode())).read_all()
+        assert status["status"][0].as_py() == "OK"
+        got = client.do_get(paflight.Ticket(
+            b"select c, sum(a) as s from t group by c order by c"
+        )).read_all().to_pandas()
+        a = np.arange(60)
+        assert list(got["c"]) == ["k0", "k1", "k2"]
+        for i in range(3):
+            assert int(got["s"][i]) == int(a[a % 3 == i].sum())
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
