@@ -510,11 +510,7 @@ def _task_status_from_proto(ts: pb.TaskStatus) -> TaskStatus:
         return TaskStatus(
             pid, "completed", executor_id=ts.completed.executor_id,
             path=ts.completed.path,
-            stats={
-                "num_rows": ts.completed.stats.num_rows,
-                "num_batches": ts.completed.stats.num_batches,
-                "num_bytes": ts.completed.stats.num_bytes,
-            },
+            stats=serde.stats_from_proto(ts.completed.stats),
         )
     return TaskStatus(pid)
 

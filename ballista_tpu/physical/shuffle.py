@@ -127,6 +127,22 @@ class ShuffleReaderExec(PhysicalPlan):
     def output_partitioning(self) -> Partitioning:
         return Partitioning("unknown", max(len(self._groups), 1))
 
+    def estimated_rows(self) -> Optional[int]:
+        """EXACT row count from the producers' write-time PartitionStats
+        (carried in every PartitionLocation) — consumers planning over
+        shuffle input (e.g. the partitioned-join threshold) get real
+        numbers, not scan-size guesses. Hash-shuffled stages fan each
+        producer out into one location PER consumer partition, all
+        carrying that producer's TOTAL stats, so counting distinct
+        producers once is what is exact."""
+        seen = {}
+        for loc in self.partition_locations:
+            n = (loc.stats or {}).get("num_rows")
+            if n is None:
+                return None
+            seen[(loc.stage_id, loc.partition_id)] = int(n)
+        return sum(seen.values())
+
     def with_new_children(self, children):
         return self
 

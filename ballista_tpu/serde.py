@@ -556,10 +556,58 @@ def location_to_proto(loc) -> pb.PartitionLocation:
         p.is_shuffle = True
         p.shuffle_output = loc.shuffle_output
     if loc.stats is not None:
-        p.partition_stats.num_rows = loc.stats.get("num_rows", 0)
-        p.partition_stats.num_batches = loc.stats.get("num_batches", 0)
-        p.partition_stats.num_bytes = loc.stats.get("num_bytes", 0)
+        stats_to_proto(loc.stats, p.partition_stats)
     return p
+
+
+def stats_to_proto(stats: dict, msg: "pb.PartitionStats") -> None:
+    """PartitionStats dict (incl. optional per-column selectivity
+    stats) -> proto."""
+    msg.num_rows = stats.get("num_rows", 0)
+    msg.num_batches = stats.get("num_batches", 0)
+    msg.num_bytes = stats.get("num_bytes", 0)
+    for c in stats.get("columns") or []:
+        cs = msg.column_stats.add()
+        cs.name = c.get("name", "")
+        cs.null_count = int(c.get("null_count", 0))
+        cs.distinct_count = int(c.get("distinct_count", -1))
+        for key, int_f, dbl_f, str_f in (
+            ("min", "min_int", "min_double", "min_str"),
+            ("max", "max_int", "max_double", "max_str"),
+        ):
+            v = c.get(key)
+            if v is None:
+                continue
+            if isinstance(v, bool):
+                setattr(cs, int_f, int(v))
+            elif isinstance(v, int):
+                setattr(cs, int_f, v)
+            elif isinstance(v, float):
+                setattr(cs, dbl_f, v)
+            else:
+                setattr(cs, str_f, str(v))
+
+
+def stats_from_proto(msg: "pb.PartitionStats") -> dict:
+    out = {
+        "num_rows": msg.num_rows,
+        "num_batches": msg.num_batches,
+        "num_bytes": msg.num_bytes,
+    }
+    cols = []
+    for cs in msg.column_stats:
+        c = {"name": cs.name, "null_count": cs.null_count,
+             "distinct_count": cs.distinct_count}
+        w = cs.WhichOneof("min_value")
+        if w is not None:
+            c["min"] = getattr(cs, w)
+        w = cs.WhichOneof("max_value")
+        if w is not None:
+            c["max"] = getattr(cs, w)
+        cols.append(c)
+    if cols:
+        out["columns"] = cols
+    return out
 
 
 def location_from_proto(p: pb.PartitionLocation):
@@ -574,9 +622,5 @@ def location_from_proto(p: pb.PartitionLocation):
         port=p.executor_meta.port,
         path=p.path,
         shuffle_output=p.shuffle_output if p.is_shuffle else None,
-        stats={
-            "num_rows": p.partition_stats.num_rows,
-            "num_batches": p.partition_stats.num_batches,
-            "num_bytes": p.partition_stats.num_bytes,
-        },
+        stats=stats_from_proto(p.partition_stats),
     )
