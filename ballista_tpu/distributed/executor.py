@@ -33,6 +33,16 @@ log = logging.getLogger("ballista.executor")
 POLL_INTERVAL_SECS = 0.25  # reference: 250ms, execution_loop.rs:41
 
 
+def _needs_mesh(plan) -> bool:
+    """True when the plan contains a mesh-fused operator (its SPMD
+    program must run on every process of a mesh group)."""
+    from ..physical.mesh_agg import MeshAggExec, MeshJoinExec
+
+    if isinstance(plan, (MeshAggExec, MeshJoinExec)):
+        return True
+    return any(_needs_mesh(c) for c in plan.children())
+
+
 class ExecutorConfig:
     """(reference: executor_config_spec.toml:1-61)"""
 
@@ -60,8 +70,12 @@ class ExecutorConfig:
 
 
 class Executor:
-    def __init__(self, config: ExecutorConfig):
+    def __init__(self, config: ExecutorConfig, mesh_group=None):
         self.config = config
+        # mesh_group: a mesh_group.GroupLeader when this executor fronts
+        # a multi-process device mesh; fused tasks are broadcast so
+        # every member enters the SPMD program together
+        self.mesh_group = mesh_group
         self.id = str(uuid.uuid4())
         self._data_plane = start_data_plane(
             config.bind_host, config.port, config.work_dir
@@ -136,7 +150,17 @@ class Executor:
 
         def work():
             try:
-                stats = self.execute_partition(pid, plan, shuffle)
+                if self.mesh_group is not None and _needs_mesh(plan):
+                    # group task: broadcast so every member process
+                    # enters the SPMD program together; serialized (the
+                    # collectives must align across processes)
+                    with self.mesh_group.lock:
+                        seq = self.mesh_group.broadcast(
+                            td.SerializeToString())
+                        stats = self.execute_partition(pid, plan, shuffle)
+                        self.mesh_group.wait_acks(seq)
+                else:
+                    stats = self.execute_partition(pid, plan, shuffle)
                 self._report_completed(pid, stats)
             except Exception as e:  # noqa: BLE001 - task failure
                 log.exception("task %s failed", pid)
@@ -205,7 +229,8 @@ class Executor:
             path = shuffle_path(self.config.work_dir, pid.job_id,
                                 pid.stage_id, pid.partition_id, q)
             base = path
-            st = ipc.write_partition(path, masked[q])
+            st = ipc.write_partition(path, masked[q],
+                                     compute_column_stats=False)
             for k in totals:
                 totals[k] += st[k]
         log.info("executed %s (shuffle x%d) in %.1fs (%d rows)", pid.key(),
@@ -219,9 +244,7 @@ class Executor:
         ts.partition_id.partition_id = pid.partition_id
         ts.completed.executor_id = self.id
         ts.completed.path = stats["path"]
-        ts.completed.stats.num_rows = stats["num_rows"]
-        ts.completed.stats.num_batches = stats["num_batches"]
-        ts.completed.stats.num_bytes = stats["num_bytes"]
+        serde.stats_to_proto(stats, ts.completed.stats)
         with self._status_lock:
             self._pending_status.append(ts)
 

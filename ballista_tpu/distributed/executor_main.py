@@ -25,6 +25,13 @@ DEFAULTS = {
     "work_dir": "",
     "concurrent_tasks": 4,
     "num_devices": 0,  # 0 = autodetect
+    # -- mesh group: executors on several hosts forming ONE device mesh --
+    "mesh_group_size": 0,  # processes in the group; 0 = no group
+    "mesh_group_rank": 0,  # this process's rank (0 = leader)
+    "mesh_group_coordinator": "",  # jax.distributed coordinator host:port
+    "mesh_group_channel": "",  # leader's task channel (host:port);
+    #                            leader binds it, followers dial it
+    "mesh_local_devices": 0,  # virtual CPU devices per process (tests)
     "log_level": "INFO",
 }
 
@@ -49,6 +56,44 @@ def main(argv=None) -> int:
     )
 
     from .executor import Executor, ExecutorConfig
+
+    group_size = int(cfg["mesh_group_size"])
+    group_rank = int(cfg["mesh_group_rank"])
+    leader = None
+    if group_size > 1:
+        # join the shared jax.distributed runtime BEFORE anything
+        # touches the backend, so every member sees the global mesh
+        from ..parallel import multihost
+
+        multihost.init_group(
+            cfg["mesh_group_coordinator"], group_size, group_rank,
+            local_device_count=int(cfg["mesh_local_devices"]) or None,
+        )
+        # backend init is ITSELF a cross-process rendezvous (each
+        # process registers its local devices with the coordinator):
+        # every member must do it now, or the first member to call
+        # jax.devices() later hangs waiting for the rest
+        import jax
+
+        n_global = len(jax.devices())
+        print(f"mesh group rank {group_rank}: global mesh has "
+              f"{n_global} devices", flush=True)
+        host, _, port_s = cfg["mesh_group_channel"].rpartition(":")
+        from . import mesh_group
+
+        if group_rank == 0:
+            leader = mesh_group.GroupLeader(
+                cfg["bind_host"], int(port_s), group_size - 1
+            )
+            print(f"mesh group leader channel on "
+                  f"{cfg['bind_host']}:{leader.port}; waiting for "
+                  f"{group_size - 1} follower(s)", flush=True)
+            leader.wait_members()
+        else:
+            print(f"mesh group follower rank {group_rank} joining "
+                  f"{host}:{port_s}", flush=True)
+            mesh_group.run_follower(host or "localhost", int(port_s))
+            return 0  # leader closed the channel: group is done
 
     scheduler_port = cfg["scheduler_port"]
     if args.local:
@@ -76,16 +121,20 @@ def main(argv=None) -> int:
         scheduler_port=scheduler_port,
         num_devices=num_devices,
     )
-    executor = Executor(exec_cfg)
+    executor = Executor(exec_cfg, mesh_group=leader)
     executor.start()
     print(
         f"ballista-tpu executor {executor.id[:8]} polling "
         f"{exec_cfg.scheduler_host}:{exec_cfg.scheduler_port}, data plane on "
-        f"{exec_cfg.host}:{executor.port}, work_dir={exec_cfg.work_dir}",
+        f"{exec_cfg.host}:{executor.port}, work_dir={exec_cfg.work_dir}"
+        + (f", mesh group of {group_size} x "
+           f"{num_devices // group_size} devices" if leader else ""),
         flush=True,
     )
     stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
     print(f"signal {stop}; shutting down", flush=True)
+    if leader is not None:
+        leader.close()
     executor.stop()
     return 0
 

@@ -1,0 +1,156 @@
+"""Multi-host mesh: one SPMD program spanning executor processes.
+
+The reference scales across hosts by moving materialized partitions
+through its Flight data plane (reference: docs/architecture.md:41-46,
+shuffle_reader.rs:77-99). The TPU-native equivalent keeps the exchange
+INSIDE the accelerator fabric: executor processes join one
+``jax.distributed`` runtime (ICI within a slice, DCN/Gloo across
+hosts), build a single global `Mesh` over every process's devices, and
+run the same shuffle/aggregation/join SPMD programs the single-host
+mesh path uses — `lax.all_to_all` rows cross host boundaries without
+touching the host data plane.
+
+Multi-controller model: every process runs the SAME program (standard
+JAX multi-host). The scheduler hands a fused task to the group's
+process 0, which broadcasts the task bytes to peers over the group
+channel; all processes enter the SPMD program together, and
+replicated outputs let process 0 report the result.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+_initialized = False
+
+
+def init_group(coordinator: str, num_processes: int, process_id: int,
+               local_device_count: Optional[int] = None) -> None:
+    """Join this process to the group's jax.distributed runtime.
+
+    Must run before any other jax call touches the backend. On CPU
+    fleets ``local_device_count`` forces N virtual devices per process
+    (tests/CI); on TPU hosts the platform provides real local devices.
+    """
+    global _initialized
+    if _initialized:
+        return
+    import os
+
+    if local_device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{local_device_count}"
+            ).strip()
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def global_mesh(axis: str = "data"):
+    """Mesh over EVERY process's devices (global, ordered by process)."""
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), (axis,))
+
+
+def local_slot_range(mesh) -> range:
+    """Global slot indices owned by THIS process (its addressable
+    devices' positions in the mesh)."""
+    devs = list(mesh.devices.flat)
+    local = set(d.id for d in jax.local_devices())
+    return [i for i, d in enumerate(devs) if d.id in local]
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+_jitted_max = None
+
+
+def host_max(arr) -> int:
+    """max over a (possibly cross-process sharded) array, readable on
+    every process. ``np.asarray`` on a global array whose shards live on
+    other processes fails; a jitted max produces a replicated scalar
+    every process holds locally. Works unchanged in single-process.
+    (One module-level jit so the retry hot paths hit its cache.)"""
+    global _jitted_max
+    if _jitted_max is None:
+        import jax.numpy as jnp
+
+        _jitted_max = jax.jit(jnp.max)
+    return int(_jitted_max(arr))
+
+
+from collections import OrderedDict
+
+# bounded: keys hold identity-hashed per-query dictionaries via treedefs
+_REPLICATE_JITS: OrderedDict = OrderedDict()
+_REPLICATE_CAP = 32
+
+
+def replicate_stacked(stacked, mesh):
+    """[n_dev, ...]-sharded pytree -> fully-replicated copy every
+    process can read (an all_gather per leaf). Used to hand a fused
+    stage's (small) final output to the group leader for
+    materialization."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from .mesh import shard_map
+
+    axis = mesh.axis_names[0]
+    key = (mesh, jax.tree.structure(stacked),
+           tuple(np.shape(x) for x in jax.tree.leaves(stacked)))
+    if key in _REPLICATE_JITS:
+        _REPLICATE_JITS.move_to_end(key)
+    else:
+        while len(_REPLICATE_JITS) >= _REPLICATE_CAP:
+            _REPLICATE_JITS.popitem(last=False)
+
+        @partial(shard_map, mesh=mesh, in_specs=(P(axis),), out_specs=P(),
+                 check_vma=False)
+        def rep(st):
+            return jax.tree.map(
+                lambda x: jax.lax.all_gather(x[0], axis), st
+            )
+
+        _REPLICATE_JITS[key] = jax.jit(rep)
+    return _REPLICATE_JITS[key](stacked)
+
+
+def stack_local_to_global(slot_batches: Sequence, mesh):
+    """Per-LOCAL-device pytrees -> one global stacked array sharded over
+    the whole mesh. Mirrors mesh_input.stack_to_mesh but supplies only
+    this process's shards; jax assembles the global view (other shards
+    live on their owning processes)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devices = [mesh.devices.flat[i] for i in local_slot_range(mesh)]
+    assert len(devices) == len(slot_batches), (
+        f"{len(slot_batches)} local slot batches for "
+        f"{len(devices)} local devices"
+    )
+    n = mesh.devices.size
+    sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+
+    def build(*xs):
+        shards = [
+            jax.device_put(x[None, ...], d) for x, d in zip(xs, devices)
+        ]
+        return jax.make_array_from_single_device_arrays(
+            (n,) + tuple(np.shape(xs[0])), sharding, shards
+        )
+
+    return jax.tree.map(build, *slot_batches)

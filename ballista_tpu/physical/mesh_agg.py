@@ -63,6 +63,18 @@ def _shuffle_side(b: ColumnBatch, hash_exprs, ev: Evaluator, n_dev: int,
                        jnp.sum(out_live).astype(jnp.int32))
 
 
+def _host_visible(stacked, mesh):
+    """Make a stacked output sliceable on THIS process: under a
+    multi-process (cross-host) mesh, some shards live on other
+    processes, so the (small) final output is all_gather-replicated
+    first; single-process meshes pass through untouched."""
+    from ..parallel.multihost import is_multiprocess, replicate_stacked
+
+    if not is_multiprocess():
+        return stacked
+    return replicate_stacked(stacked, mesh)
+
+
 class _SchemaOnly(PhysicalPlan):
     """Placeholder child that only carries a schema (the mesh runner
     feeds batches directly, there is nothing to execute)."""
@@ -157,6 +169,7 @@ class MeshAggExec(PhysicalPlan):
         """Device-resident execution: stacked [n_dev, cap] output sharded
         over the mesh — consumed directly by a chained fused stage (HBM
         partition cache) or sliced per device by ``execute``."""
+        from ..parallel.multihost import host_max
         from .mesh_input import stacked_input
 
         stacked, in_cap = stacked_input(self.producer, self._partial_schema,
@@ -164,7 +177,7 @@ class MeshAggExec(PhysicalPlan):
         cap = self.group_capacity
         while True:
             out_stacked, num_groups = self._spmd(stacked, mesh, cap, in_cap)
-            ng = int(np.max(np.asarray(num_groups)))
+            ng = host_max(num_groups)  # multihost-safe replicated max
             if ng <= cap:
                 return out_stacked
             cap = round_capacity(ng)  # overflow: recompile with exact cap
@@ -173,7 +186,7 @@ class MeshAggExec(PhysicalPlan):
         if partition != 0:
             raise ExecutionError("MeshAggExec has a single output partition")
         mesh = make_mesh(self.n_devices)
-        out_stacked = self.execute_stacked(mesh)
+        out_stacked = _host_visible(self.execute_stacked(mesh), mesh)
         for q in range(self.n_devices):
             yield jax.tree.map(lambda x, _q=q: jnp.asarray(x)[_q],
                                out_stacked)
@@ -407,13 +420,15 @@ class MeshJoinExec(PhysicalPlan):
         sp, p_cap = stacked_input(
             self.probe_producer, self.probe_producer.output_schema(), mesh)
         remaps = self._join._remaps_for(sb, sp)
+        from ..parallel.multihost import host_max
+
         out_cap = self.n_devices * p_cap  # post-shuffle probe rows/device
         if self.how == "full":  # + room for unmatched build rows
             out_cap = round_capacity(out_cap + self.n_devices * b_cap)
         while True:
             out_stacked, totals = self._spmd(sb, sp, mesh, remaps, out_cap,
                                              b_cap, p_cap)
-            t = int(np.max(np.asarray(totals)))
+            t = host_max(totals)  # multihost-safe replicated max
             if t <= out_cap:
                 return out_stacked
             out_cap = round_capacity(t)  # duplicate-heavy keys: retry
@@ -424,7 +439,7 @@ class MeshJoinExec(PhysicalPlan):
         from .base import maybe_compact
 
         mesh = make_mesh(self.n_devices)
-        out_stacked = self.execute_stacked(mesh)
+        out_stacked = _host_visible(self.execute_stacked(mesh), mesh)
         for q in range(self.n_devices):
             # selective joins (semi/anti especially) leave mostly-dead
             # slices; shrink them like the host join does before handing

@@ -27,7 +27,7 @@ from typing import List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..columnar import (
     Column,
@@ -152,20 +152,11 @@ def stack_to_mesh(slot_batches: List[ColumnBatch], mesh):
     are [n_dev, ...] arrays sharded over the mesh axis. Each slot's
     leaves are placed on their device (a device-to-device copy when the
     slot was computed elsewhere — ICI, never host) and assembled without
-    any global materialization."""
-    devices = list(mesh.devices.flat)
-    n = len(devices)
-    sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+    any global materialization. Single-process alias of
+    multihost.stack_local_to_global (where local devices = all)."""
+    from ..parallel.multihost import stack_local_to_global
 
-    def build(*xs):
-        shards = [
-            jax.device_put(x[None, ...], d) for x, d in zip(xs, devices)
-        ]
-        return jax.make_array_from_single_device_arrays(
-            (n,) + tuple(np.shape(xs[0])), sharding, shards
-        )
-
-    return jax.tree.map(build, *slot_batches)
+    return stack_local_to_global(slot_batches, mesh)
 
 
 def assemble_over_mesh(producer, schema: Schema, mesh
@@ -177,61 +168,88 @@ def assemble_over_mesh(producer, schema: Schema, mesh
     are ROW-split instead (device-side window slices of the compacted
     whole), so a 1-partition dim-table scan doesn't put every row in one
     slot and inflate the uniform capacity n_dev-fold.
+
+    Multi-process (cross-host) meshes: each process executes only the
+    partitions of ITS devices' slots and supplies only local shards; the
+    uniform capacity is agreed through a replicated global max.
+    Correctness requires utf8 dictionaries to be content-identical
+    across processes — guaranteed for table scans (table-wide
+    dictionaries are built over all partitions of the source, io/text.py).
     Returns (stacked batch, per-device capacity)."""
+    from ..parallel import multihost
+
     devices = list(mesh.devices.flat)
     n_dev = len(devices)
+    multi = multihost.is_multiprocess()
+    local_ids = {d.id for d in jax.local_devices()}
+    local_slots = [i for i, d in enumerate(devices)
+                   if not multi or d.id in local_ids]
     nparts = producer.output_partitioning().num_partitions
     row_split = nparts < n_dev
     slots: List[List[ColumnBatch]] = [[] for _ in range(n_dev)]
     for p in range(nparts):
+        slot = p % n_dev
+        if multi and not row_split and slot not in local_slots:
+            continue  # another process owns this slot's device
         if row_split:
-            slots[p % n_dev].extend(producer.execute(p))
+            slots[slot].extend(producer.execute(p))
         else:
-            with jax.default_device(devices[p % n_dev]):
+            with jax.default_device(devices[slot]):
                 for b in producer.execute(p):
-                    slots[p % n_dev].append(b)
-    for s in slots:
-        if not s:
-            s.append(empty_batch(schema))
+                    slots[slot].append(b)
+    for i in local_slots:
+        if not slots[i] and not row_split:
+            slots[i].append(empty_batch(schema))
 
     flat = [b for s in slots for b in s]
     dicts, remap_rows = _union_dicts(schema, flat)
 
     from .base import concat_batches
 
-    slot_bigs: List[ColumnBatch] = []
+    slot_bigs: dict = {}
     i = 0
-    for s in slots:
+    for idx in range(n_dev):
+        s = slots[idx]
+        if not s:
+            continue
         rows = remap_rows[i : i + len(s)]
         i += len(s)
         remapped = [
             _apply_remaps(schema, b, r, dicts) for b, r in zip(s, rows)
         ]
-        big = (remapped[0] if len(remapped) == 1
-               else concat_batches(schema, remapped))
-        slot_bigs.append(big)
+        slot_bigs[idx] = (remapped[0] if len(remapped) == 1
+                          else concat_batches(schema, remapped))
 
     STATS["slot_assemblies"] += 1
     if row_split:
-        big = (slot_bigs[0] if len(slot_bigs) == 1
-               else concat_batches(schema, slot_bigs))
+        # every process reads the whole (small) producer and slices its
+        # local windows — duplicated work, but globally consistent
+        bigs = [slot_bigs[k] for k in sorted(slot_bigs)]
+        big = bigs[0] if len(bigs) == 1 else concat_batches(schema, bigs)
         n = int(big.num_rows)  # scalar sync only
         cap = round_capacity(max(-(-n // n_dev), 1))
         packed = _compact_to(big, cap=n_dev * cap)
         slot_batches = [
             _window_slot(packed, d * cap, cap,
                          min(max(n - d * cap, 0), cap))
-            for d in range(n_dev)
+            for d in local_slots
         ]
-        return stack_to_mesh(slot_batches, mesh), cap
+        return multihost.stack_local_to_global(slot_batches, mesh), cap
 
-    # ONE batched fetch for all slot counts: sequential int() reads
-    # would pay a device->host round-trip per device
-    counts = [int(c) for c in
-              jax.device_get([b.num_rows for b in slot_bigs])]
-    cap = round_capacity(max(max(counts), 1))
-    slot_batches = [_compact_to(b, cap=cap) for b in slot_bigs]
-    return stack_to_mesh(slot_batches, mesh), cap
+    if multi:
+        # capacity must agree across processes: replicated global max
+        local_counts = [slot_bigs[i].num_rows for i in local_slots]
+        gcounts = multihost.stack_local_to_global(local_counts, mesh)
+        cap = round_capacity(max(multihost.host_max(gcounts), 1))
+    else:
+        # ONE batched fetch for all slot counts: sequential int() reads
+        # would pay a device->host round-trip per device
+        counts = [int(c) for c in jax.device_get(
+            [slot_bigs[i].num_rows for i in local_slots])]
+        cap = round_capacity(max(max(counts), 1))
+    slot_batches = [_compact_to(slot_bigs[i], cap=cap)
+                    for i in local_slots]
+    return multihost.stack_local_to_global(slot_batches, mesh), cap
 
 
 def _window_slot(packed: ColumnBatch, start: int, cap: int,
@@ -268,9 +286,10 @@ def _maybe_compact_stacked(stacked: ColumnBatch, mesh,
                            shrink_factor: int = 4) -> ColumnBatch:
     """Shrink a sparse stacked batch with one per-device SPMD compaction
     (costs a host sync on the [n_dev] live counts — int32s, not data)."""
-    counts = np.asarray(stacked.num_rows)
+    from ..parallel.multihost import host_max
+
     cap = int(stacked.selection.shape[1])
-    new_cap = max(round_capacity(int(counts.max(initial=0))), 8)
+    new_cap = max(round_capacity(host_max(stacked.num_rows)), 8)
     if new_cap * shrink_factor > cap:
         return stacked
     axis = mesh.axis_names[0]
@@ -333,7 +352,9 @@ def _chain_partial_agg(agg, inner: ColumnBatch, mesh) -> ColumnBatch:
 
             cache[key] = jax.jit(run)
         out_stacked, ngs = cache[key](inner)
-        ng = int(np.max(np.asarray(ngs)))
+        from ..parallel.multihost import host_max
+
+        ng = host_max(ngs)  # multihost-safe replicated max
         if ng <= cap:
             return out_stacked
         cap = round_capacity(ng)
