@@ -113,6 +113,18 @@ class BallistaContext:
             primary_key,
         )
 
+    def register_table(self, name: str, df: "DataFrame") -> None:
+        """Register a DataFrame as a named table (view semantics): SQL
+        referencing ``name`` inlines the frame's logical plan, exactly
+        the role the reference's DFTableAdapter plays for registered
+        frames (reference: rust/core/src/datasource.rs:28-66;
+        rust/client/src/context.rs:131-144 registers DataFrames before
+        planning SQL)."""
+        if df._plan is None:
+            raise PlanError("register_table requires a planned DataFrame")
+        self._catalog[name] = CatalogTable(name, None, None, plan=df._plan)
+        self._plan_cache.clear()
+
     def deregister_table(self, name: str) -> None:
         self._catalog.pop(name, None)
         self._plan_cache.clear()
@@ -140,6 +152,8 @@ class BallistaContext:
         if name not in self._catalog:
             raise PlanError(f"unknown table {name!r}")
         t = self._catalog[name]
+        if t.plan is not None:  # registered DataFrame view: inline it
+            return DataFrame(self, t.plan)
         return DataFrame(self, TableScan(t.name, t.source))
 
     # -- SQL ----------------------------------------------------------------

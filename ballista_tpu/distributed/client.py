@@ -45,6 +45,12 @@ def submit_sql(host: str, port: int, sql: str, catalog,
         for k, v in (settings or {}).items():
             params.settings[k] = v
         for name, ct in (catalog or {}).items():
+            if ct.source is None:
+                # plan-backed view (register_table): views are planned
+                # client-side and cannot ship as a source descriptor.
+                # Skip it — a server-planned query that actually
+                # references the name fails there with "unknown table"
+                continue
             entry = params.catalog.add()
             entry.name = name
             entry.source.CopyFrom(

@@ -37,6 +37,16 @@ log = logging.getLogger("ballista.mesh_group")
 _ACK_OK = 0
 _ACK_FAILED = 1
 
+# Longest a single group task may run on a follower before the leader
+# gives up waiting for its ack. A timeout here is a GROUP failure (the
+# SPMD streams desynchronize), so it is deliberately generous; override
+# via BALLISTA_MESH_GROUP_ACK_TIMEOUT for larger-than-usual workloads.
+import os as _os
+
+ACK_TIMEOUT_SECS = float(
+    _os.environ.get("BALLISTA_MESH_GROUP_ACK_TIMEOUT", 3600)
+)
+
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
@@ -71,7 +81,11 @@ class GroupLeader:
         self._srv.settimeout(self._accept_timeout)
         while len(self._conns) < self.num_followers:
             conn, addr = self._srv.accept()
-            conn.settimeout(600.0)
+            # ack wait bound = the longest a group task may run on a
+            # follower; generous because exceeding it desynchronizes the
+            # group's SPMD streams (leader re-broadcasts while the
+            # follower is still inside the old task's collectives)
+            conn.settimeout(ACK_TIMEOUT_SECS)
             self._conns.append(conn)
             log.info("mesh group follower joined from %s (%d/%d)", addr,
                      len(self._conns), self.num_followers)

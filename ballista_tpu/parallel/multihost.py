@@ -63,7 +63,7 @@ def global_mesh(axis: str = "data"):
     return Mesh(np.asarray(jax.devices()), (axis,))
 
 
-def local_slot_range(mesh) -> range:
+def local_slot_range(mesh) -> List[int]:
     """Global slot indices owned by THIS process (its addressable
     devices' positions in the mesh)."""
     devs = list(mesh.devices.flat)

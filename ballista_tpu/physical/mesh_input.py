@@ -225,6 +225,8 @@ def assemble_over_mesh(producer, schema: Schema, mesh
         # every process reads the whole (small) producer and slices its
         # local windows — duplicated work, but globally consistent
         bigs = [slot_bigs[k] for k in sorted(slot_bigs)]
+        if not bigs:  # producer emitted nothing (e.g. empty MemTable)
+            bigs = [empty_batch(schema)]
         big = bigs[0] if len(bigs) == 1 else concat_batches(schema, bigs)
         n = int(big.num_rows)  # scalar sync only
         cap = round_capacity(max(-(-n // n_dev), 1))

@@ -47,8 +47,12 @@ from .parser import JoinClause, OrderItem, Query, SelectItem, TableRef
 @dataclass
 class CatalogTable:
     name: str
-    source: TableSource
+    source: Optional[TableSource]
     primary_key: Optional[str] = None  # unique column, for join-side choice
+    # view semantics: a registered DataFrame's logical plan, inlined
+    # wherever SQL references the name (the reference wraps registered
+    # frames the same way: DFTableAdapter, rust/core/src/datasource.rs:28-66)
+    plan: Optional["LogicalPlan"] = None
 
 
 @dataclass
@@ -115,9 +119,15 @@ class SqlPlanner:
                 if r.name not in self.catalog:
                     raise SqlError(f"unknown table {r.name!r}")
                 t = self.catalog[r.name]
-                raw.append(
-                    (alias, r, t.source.table_schema(), t.primary_key, None)
-                )
+                if t.plan is not None:  # registered DataFrame: a view
+                    raw.append(
+                        (alias, r, t.plan.schema(), t.primary_key, t.plan)
+                    )
+                else:
+                    raw.append(
+                        (alias, r, t.source.table_schema(), t.primary_key,
+                         None)
+                    )
         seen: Dict[str, int] = {}
         for _, _, sch, _, _ in raw:
             for n in sch.names():

@@ -63,3 +63,33 @@ def test_dataframe_select_columns_repartition_count():
     got = df.collect()
     assert sorted(got.columns) == ["k", "v"]
     assert int(got["v"].sum()) == int(t.v.sum())
+
+
+def test_register_table_view_semantics():
+    """A registered DataFrame acts as a named view in SQL — the role the
+    reference's DFTableAdapter plays (rust/core/src/datasource.rs:28-66):
+    referencing SQL inlines the frame's logical plan, including joins
+    against base tables."""
+    ctx, tdf, ddf = _ctx()
+    view = ctx.sql("select k, sum(v) as sv from t group by k")
+    ctx.register_table("agg_view", view)
+
+    got = ctx.sql(
+        "select a.k, a.sv, d.w from agg_view a, d where a.k = d.dk "
+        "order by a.k"
+    ).collect()
+    exp = (tdf.groupby("k").agg(sv=("v", "sum")).reset_index()
+           .merge(ddf, left_on="k", right_on="dk")
+           .sort_values("k")[["k", "sv", "w"]])
+    np.testing.assert_array_equal(got["k"], exp["k"])
+    np.testing.assert_array_equal(got["sv"].astype(np.int64),
+                                  exp["sv"].astype(np.int64))
+    np.testing.assert_array_equal(got["w"].astype(np.int64),
+                                  exp["w"].astype(np.int64))
+
+    # views compose: a view over a view
+    ctx.register_table("top", ctx.sql(
+        "select k, sv from agg_view where sv > 100"))
+    got2 = ctx.sql("select count(*) as n from top").collect()
+    exp2 = int((tdf.groupby("k")["v"].sum() > 100).sum())
+    assert int(got2["n"][0]) == exp2
