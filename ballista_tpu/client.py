@@ -120,9 +120,16 @@ class BallistaContext:
         frames (reference: rust/core/src/datasource.rs:28-66;
         rust/client/src/context.rs:131-144 registers DataFrames before
         planning SQL)."""
-        if df._plan is None:
-            raise PlanError("register_table requires a planned DataFrame")
-        self._catalog[name] = CatalogTable(name, None, None, plan=df._plan)
+        # df.plan plans raw-SQL (server-planned) frames on demand and
+        # raises PlanError for true DDL frames that carry no plan.
+        # Copy it: executing the original frame mutates its plan in
+        # place (scalar subqueries resolve to literals) and the view
+        # must not inherit those baked values. Sources are shared
+        # (TableSource.__deepcopy__).
+        import copy
+
+        self._catalog[name] = CatalogTable(name, None, None,
+                                           plan=copy.deepcopy(df.plan))
         self._plan_cache.clear()
 
     def deregister_table(self, name: str) -> None:
@@ -152,8 +159,12 @@ class BallistaContext:
         if name not in self._catalog:
             raise PlanError(f"unknown table {name!r}")
         t = self._catalog[name]
-        if t.plan is not None:  # registered DataFrame view: inline it
-            return DataFrame(self, t.plan)
+        if t.plan is not None:  # registered DataFrame view: inline a
+            # copy — execution mutates plans in place and the catalog's
+            # must stay pristine (sources are shared, not cloned)
+            import copy
+
+            return DataFrame(self, copy.deepcopy(t.plan))
         return DataFrame(self, TableScan(t.name, t.source))
 
     # -- SQL ----------------------------------------------------------------

@@ -404,6 +404,11 @@ def decode_physical_array(
         if has_nulls:
             out[null_mask] = np.datetime64("NaT")
         return out
+    if kind == "timestamp_ns":
+        out = np.asarray(vals).astype(np.int64).astype("datetime64[ns]")
+        if has_nulls:
+            out[null_mask] = np.datetime64("NaT")
+        return out
     if kind == "decimal":
         out = np.asarray(vals).astype(np.float64) / (10.0 ** scale)
     elif kind in ("float32", "float64"):

@@ -67,7 +67,7 @@ class DataType:
 
     @property
     def is_temporal(self) -> bool:
-        return self.kind == "date32"
+        return self.kind in ("date32", "timestamp_ns")
 
     # -- device representation ----------------------------------------------
 
@@ -81,6 +81,7 @@ class DataType:
             "decimal": np.int64,
             "boolean": np.bool_,
             "date32": np.int32,
+            "timestamp_ns": np.int64,  # epoch nanoseconds
             "utf8": np.int32,  # dictionary codes
         }
         if self.kind not in m:
@@ -95,6 +96,9 @@ Float64 = DataType("float64")
 Boolean = DataType("boolean")
 Utf8 = DataType("utf8")
 Date32 = DataType("date32")
+# Epoch-nanosecond timestamps (the reference's TOTIMESTAMP result type,
+# reference: rust/core/proto/ballista.proto:104 TOTIMESTAMP)
+TimestampNs = DataType("timestamp_ns")
 
 
 def Decimal(scale: int = 2) -> DataType:
@@ -124,6 +128,9 @@ _BY_NAME = {
     "text": Utf8,
     "date": Date32,
     "date32": Date32,
+    "timestamp": TimestampNs,
+    "timestamp_ns": TimestampNs,
+    "datetime": TimestampNs,
 }
 
 

@@ -10,7 +10,7 @@ import os
 import time
 from typing import Dict, Optional
 
-from ..errors import ClusterError
+from ..errors import ClusterError, PlanError
 from ..proto import ballista_pb2 as pb
 from .. import serde
 from .dataplane import fetch_partition_bytes
@@ -32,6 +32,34 @@ def submit_plan(host: str, port: int, logical_plan,
         client.close()
 
 
+def _sql_references_table(sql: str, name: str) -> bool:
+    """True when ``name`` appears in a table position (after FROM/JOIN or
+    a FROM-list comma). Token-based so column aliases, string literals,
+    and comments named like the table don't count."""
+    from ..sql.lexer import tokenize
+
+    try:
+        toks = tokenize(sql)
+    except Exception:
+        return False  # unparseable here -> let the server report it
+    lname = name.lower()
+    prev = None
+    in_from = False  # inside a FROM list, where commas introduce tables
+    for t in toks:
+        if t.kind == "kw":
+            if t.value == "from":
+                in_from = True
+            elif t.value in ("where", "group", "having", "order", "limit",
+                             "select", "on"):
+                in_from = False
+        if (t.kind == "ident" and t.value.lower() == lname and prev is not None
+                and (prev.is_kw("from", "join") or
+                     (in_from and prev.kind == "op" and prev.value == ","))):
+            return True
+        prev = t
+    return False
+
+
 def submit_sql(host: str, port: int, sql: str, catalog,
                settings: Optional[Dict[str, str]] = None) -> str:
     """Raw-SQL submission: the scheduler plans server-side against the
@@ -48,8 +76,14 @@ def submit_sql(host: str, port: int, sql: str, catalog,
             if ct.source is None:
                 # plan-backed view (register_table): views are planned
                 # client-side and cannot ship as a source descriptor.
-                # Skip it — a server-planned query that actually
-                # references the name fails there with "unknown table"
+                # Fail here (actionably) if the query references it.
+                if _sql_references_table(sql, name):
+                    raise PlanError(
+                        f"view {name!r} was registered from a DataFrame and "
+                        "cannot be used with server-side SQL planning; plan "
+                        "client-side (settings['plan.server']='off') or "
+                        "register the underlying source instead"
+                    )
                 continue
             entry = params.catalog.add()
             entry.name = name

@@ -53,7 +53,12 @@ def _table_from_pydict(data: dict) -> "pa.Table":
         if a.dtype == object:
             cols[name] = pa.array(a.tolist(), type=pa.string())
         elif np.issubdtype(a.dtype, np.datetime64):
-            cols[name] = pa.array(a.astype("datetime64[D]"))
+            # date32 results decode as datetime64[D]; timestamp_ns as
+            # datetime64[ns] — preserve sub-day precision for the latter
+            if np.datetime_data(a.dtype)[0] == "D":
+                cols[name] = pa.array(a)
+            else:
+                cols[name] = pa.array(a.astype("datetime64[ns]"))
         else:
             cols[name] = pa.array(a)
     return pa.table(cols)

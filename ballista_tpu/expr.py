@@ -526,6 +526,14 @@ SCALAR_FUNCTIONS = {
     "rtrim": (1, "utf8"),
     "length": (1, "int"),
     "character_length": (1, "int"),
+    "octet_length": (1, "int"),
+    "md5": (1, "utf8"),
+    "sha224": (1, "utf8"),
+    "sha256": (1, "utf8"),
+    "sha384": (1, "utf8"),
+    "sha512": (1, "utf8"),
+    "date_trunc": (2, "arg1"),  # date_trunc('month', d) -> d's type
+    "to_timestamp": (1, "timestamp"),
     "substr": (3, "utf8"),
     "concat": (-1, "utf8"),
     "date_part": (2, "int"),
@@ -565,6 +573,12 @@ class ScalarFunction(Expr):
             return Field(self.name(), Boolean, nullable)
         if rule == "utf8":
             return Field(self.name(), Utf8, nullable)
+        if rule == "arg1":
+            return Field(self.name(), self.args[1].to_field(schema).dtype, nullable)
+        if rule == "timestamp":
+            from .datatypes import TimestampNs
+
+            return Field(self.name(), TimestampNs, nullable)
         raise PlanError(f"bad rule for {self.fn}")
 
 

@@ -48,6 +48,10 @@ def _arrow_to_dtype(t) -> DataType:
         return Decimal(t.scale)
     if pa.types.is_date(t):
         return Date32
+    if pa.types.is_timestamp(t):
+        from ..datatypes import TimestampNs
+
+        return TimestampNs
     if pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_dictionary(t):
         return Utf8
     raise IoError(f"unsupported parquet type {t}")
@@ -146,6 +150,13 @@ class ParquetSource(TableSource):
                 if not pa.types.is_date32(arr.type):
                     arr = arr.cast(pa.date32())
                 arrays[name] = arr.cast(pa.int32()).to_numpy(
+                    zero_copy_only=False
+                )
+            elif field.dtype.kind == "timestamp_ns":
+                import pyarrow as pa
+
+                arr = colarr.cast(pa.timestamp("ns"))
+                arrays[name] = arr.cast(pa.int64()).to_numpy(
                     zero_copy_only=False
                 )
             else:

@@ -139,10 +139,13 @@ class DelimitedSource(TableSource):
 
     def _use_native(self) -> bool:
         # the native scanner does no quote handling; use it only for the
-        # unquoted '|' (TPC-H .tbl) format and keep quoted CSV on pandas
+        # unquoted '|' (TPC-H .tbl) format and keep quoted CSV on pandas.
+        # Types it has no kind code for (timestamps) also fall back.
         from . import native
 
-        return native.available() and self._delim == "|"
+        return (native.available() and self._delim == "|"
+                and all(f.dtype.kind in native._KIND_CODES
+                        for f in self._schema.fields))
 
     def scan(self, partition: int, projection: Optional[Sequence[str]] = None):
         names = projection if projection is not None else self._schema.names()
@@ -213,6 +216,9 @@ class DelimitedSource(TableSource):
             elif field.dtype.kind == "date32":
                 vals = raw.astype(str).to_numpy(dtype="datetime64[D]")
                 arrays[name] = vals.astype(np.int32)
+            elif field.dtype.kind == "timestamp_ns":
+                vals = raw.astype(str).to_numpy(dtype="datetime64[ns]")
+                arrays[name] = vals.astype(np.int64)
             else:
                 arrays[name] = raw.to_numpy(dtype=field.dtype.device_dtype())
         return n, arrays, dicts

@@ -25,6 +25,37 @@ def civil_from_days(days):
     return year.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
 
 
+def days_from_civil(y, m, d):
+    """(year, month, day) int32 arrays -> days since 1970-01-01 (inverse
+    of civil_from_days; same public-domain algorithm family)."""
+    y = y.astype(jnp.int32) - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400  # [0, 399]
+    mp = m + jnp.where(m > 2, -3, 9)  # [0, 11]
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def date_trunc(part: str, days):
+    """Truncate days-since-epoch to the start of year/quarter/month/week/day.
+    ``part`` is static (baked into the trace). The reference exposes this as
+    the DATETRUNC scalar function (reference: rust/core/proto/ballista.proto:107)."""
+    if part == "day":
+        return days.astype(jnp.int32)
+    if part == "week":  # ISO weeks start Monday; 1970-01-01 was a Thursday
+        return (days - jnp.mod(days + 3, 7)).astype(jnp.int32)
+    y, m, _ = civil_from_days(days)
+    one = jnp.ones_like(m)
+    if part == "year":
+        return days_from_civil(y, one, one)
+    if part == "quarter":
+        return days_from_civil(y, ((m - 1) // 3) * 3 + 1, one)
+    if part == "month":
+        return days_from_civil(y, m, one)
+    raise ValueError(f"date_trunc part {part!r}")
+
+
 def extract_year(days):
     return civil_from_days(days)[0]
 

@@ -506,9 +506,11 @@ class Evaluator:
         fn = e.fn
         # string functions -> host dictionary transforms
         if fn in ("upper", "lower", "trim", "ltrim", "rtrim", "substr", "length",
-                  "character_length", "concat"):
+                  "character_length", "octet_length", "concat", "md5",
+                  "sha224", "sha256", "sha384", "sha512", "to_timestamp"):
             return self._eval_string_fn(e, batch)
-        if fn in ("extract_year", "extract_month", "extract_day", "date_part"):
+        if fn in ("extract_year", "extract_month", "extract_day", "date_part",
+                  "date_trunc"):
             return self._eval_date_fn(e, batch)
         args = [self.evaluate(a, batch) for a in e.args]
         validity = _and_validity(*[a.validity for a in args])
@@ -547,22 +549,75 @@ class Evaluator:
             raise NotImplementedError_(f"scalar function {fn}")
         return Evaluated(jfn(xv), Float64, validity)
 
+    @staticmethod
+    def _literal_part(e: ex.ScalarFunction, arg_index: int = 0) -> str:
+        part = e.args[arg_index]
+        name = part.value if isinstance(part, ex.Literal) else None
+        if name is None:
+            raise PlanError(f"{e.fn} requires a literal part name")
+        return str(name).lower()
+
+    _NS_PER_DAY = 86_400_000_000_000
+
+    def _as_epoch_days(self, x: Evaluated):
+        """Temporal value -> days-since-epoch int32 (timestamps floor to
+        their calendar day)."""
+        if x.dtype.kind == "timestamp_ns":
+            return jnp.floor_divide(
+                x.values, jnp.int64(self._NS_PER_DAY)).astype(jnp.int32)
+        return x.values
+
+    _NS_PER = {"hour": 3_600_000_000_000, "minute": 60_000_000_000,
+               "second": 1_000_000_000}
+
     def _eval_date_fn(self, e: ex.ScalarFunction, batch: ColumnBatch) -> Evaluated:
-        if e.fn == "date_part":
-            part = e.args[0]
-            part_name = part.value if isinstance(part, ex.Literal) else None
-            if part_name is None:
-                raise PlanError("date_part requires a literal part name")
+        if e.fn == "date_trunc":
+            part_name = self._literal_part(e)
             x = self.evaluate(e.args[1], batch)
-            fn = {"year": date_kernels.extract_year,
-                  "month": date_kernels.extract_month,
-                  "day": date_kernels.extract_day}[str(part_name).lower()]
-            return Evaluated(fn(x.values), Int32, x.validity)
+            if part_name in self._NS_PER or part_name == "day":
+                if x.dtype.kind != "timestamp_ns":  # dates: day- no-ops
+                    if part_name == "day":
+                        return x
+                    raise PlanError(
+                        f"date_trunc({part_name!r}) needs a timestamp, "
+                        f"got {x.dtype}")
+                unit = jnp.int64(self._NS_PER.get(part_name,
+                                                  self._NS_PER_DAY))
+                return Evaluated(
+                    jnp.floor_divide(x.values, unit) * unit, x.dtype,
+                    x.validity)
+            if part_name not in ("year", "quarter", "month", "week"):
+                raise PlanError(f"date_trunc part {part_name!r}")
+            days = self._as_epoch_days(x)
+            td = date_kernels.date_trunc(part_name, days)
+            if x.dtype.kind == "timestamp_ns":
+                td = td.astype(jnp.int64) * jnp.int64(self._NS_PER_DAY)
+            return Evaluated(td, x.dtype, x.validity)
+        if e.fn == "date_part":
+            part_name = self._literal_part(e)
+            x = self.evaluate(e.args[1], batch)
+            return self._extract_part(part_name, x)
         x = self.evaluate(e.args[0], batch)
-        fn = {"extract_year": date_kernels.extract_year,
-              "extract_month": date_kernels.extract_month,
-              "extract_day": date_kernels.extract_day}[e.fn]
-        return Evaluated(fn(x.values), Int32, x.validity)
+        return self._extract_part(e.fn.removeprefix("extract_"), x)
+
+    def _extract_part(self, part_name: str, x: Evaluated) -> Evaluated:
+        if part_name in self._NS_PER:
+            if x.dtype.kind != "timestamp_ns":
+                raise PlanError(
+                    f"date_part({part_name!r}) needs a timestamp, "
+                    f"got {x.dtype}")
+            unit = jnp.int64(self._NS_PER[part_name])
+            mod = jnp.int64(self._NS_PER_DAY if part_name == "hour"
+                            else self._NS_PER["hour"] if part_name == "minute"
+                            else self._NS_PER["minute"])
+            v = jnp.floor_divide(jnp.mod(x.values, mod), unit)
+            return Evaluated(v.astype(jnp.int32), Int32, x.validity)
+        fn = {"year": date_kernels.extract_year,
+              "month": date_kernels.extract_month,
+              "day": date_kernels.extract_day}.get(part_name)
+        if fn is None:
+            raise PlanError(f"date_part part {part_name!r}")
+        return Evaluated(fn(self._as_epoch_days(x)), Int32, x.validity)
 
     def _eval_string_fn(self, e: ex.ScalarFunction, batch: ColumnBatch) -> Evaluated:
         fn = e.fn
@@ -572,10 +627,55 @@ class Evaluator:
         if base.dictionary is None:
             raise NotImplementedError_(f"{fn} on non-dictionary column")
         d = base.dictionary
-        if fn in ("length", "character_length"):
-            host = np.asarray([len(str(v)) for v in d.values], dtype=np.int32)
+        if fn in ("length", "character_length", "octet_length"):
+            if fn == "octet_length":  # bytes, not codepoints
+                host = np.asarray(
+                    [len(str(v).encode("utf-8")) for v in d.values],
+                    dtype=np.int32)
+            else:
+                host = np.asarray([len(str(v)) for v in d.values],
+                                  dtype=np.int32)
             out = jnp.take(jnp.asarray(host), base.values.astype(jnp.int32), mode="clip")
             return Evaluated(out, Int32, base.validity)
+        if fn in ("md5", "sha224", "sha256", "sha384", "sha512"):
+            # dictionary transform: hash each distinct string once
+            import hashlib
+
+            h = getattr(hashlib, fn)
+            return self._remapped_dict(
+                base, [h(str(v).encode("utf-8")).hexdigest() for v in d.values]
+            )
+        if fn == "to_timestamp":
+            # parse each distinct string once -> epoch-ns lookup table
+            from ..datatypes import TimestampNs
+
+            # ns-representable range; np.datetime64(s, "ns") silently
+            # WRAPS int64 outside it instead of raising
+            lo = np.datetime64("1677-09-22", "s")
+            hi = np.datetime64("2262-04-11", "s")
+
+            def parse_one(v):
+                try:
+                    d = np.datetime64(str(v))  # native unit, no wrap
+                except ValueError:
+                    return np.datetime64("NaT", "ns")
+                if np.isnat(d) or not (lo <= d.astype("datetime64[s]") <= hi):
+                    return np.datetime64("NaT", "ns")
+                return d.astype("datetime64[ns]")
+
+            parsed = np.asarray([parse_one(v) for v in d.values],
+                                dtype="datetime64[ns]")
+            host = parsed.astype(np.int64)
+            bad = np.isnat(parsed)
+            out = jnp.take(jnp.asarray(host), base.values.astype(jnp.int32),
+                           mode="clip")
+            validity = base.validity
+            if bad.any():
+                ok = jnp.take(jnp.asarray(~bad),
+                              base.values.astype(jnp.int32), mode="clip")
+                validity = ok if validity is None else jnp.logical_and(
+                    validity, ok)
+            return Evaluated(out, TimestampNs, validity)
         if fn == "substr":
             start = e.args[1]
             length = e.args[2]

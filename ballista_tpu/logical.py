@@ -48,6 +48,11 @@ class LogicalPlan:
 class TableSource:
     """Provider interface for scannable tables (io layer implements it)."""
 
+    def __deepcopy__(self, memo):
+        # deep-copying a plan (e.g. inlining a registered view) must
+        # SHARE sources, not clone their data/caches
+        return self
+
     def table_schema(self) -> Schema:
         raise NotImplementedError
 

@@ -120,8 +120,15 @@ class SqlPlanner:
                     raise SqlError(f"unknown table {r.name!r}")
                 t = self.catalog[r.name]
                 if t.plan is not None:  # registered DataFrame: a view
+                    # inline a COPY: execution mutates plans in place
+                    # (resolve_scalar_subqueries bakes literals into expr
+                    # nodes), and the catalog's plan must stay pristine
+                    # across queries and re-registrations
+                    import copy
+
+                    vplan = copy.deepcopy(t.plan)
                     raw.append(
-                        (alias, r, t.plan.schema(), t.primary_key, t.plan)
+                        (alias, r, vplan.schema(), t.primary_key, vplan)
                     )
                 else:
                     raw.append(

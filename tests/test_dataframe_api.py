@@ -93,3 +93,44 @@ def test_register_table_view_semantics():
     got2 = ctx.sql("select count(*) as n from top").collect()
     exp2 = int((tdf.groupby("k")["v"].sum() > 100).sum())
     assert int(got2["n"][0]) == exp2
+
+
+def test_view_plan_isolated_from_mutation():
+    """register_table snapshots the plan: executing the original frame
+    (which resolves scalar subqueries in place, baking literals into its
+    expr nodes) must not contaminate the view, and repeated view queries
+    must be self-consistent. Views pin their sources at registration —
+    the same inlined-plan semantics as the reference's DFTableAdapter
+    (reference: rust/core/src/datasource.rs:28-66)."""
+    from ballista_tpu import schema, Int64
+    from ballista_tpu.client import BallistaContext
+
+    ctx = BallistaContext.standalone()
+    ctx.register_memtable("base", schema(("v", Int64)), {"v": [1, 2, 3]})
+    df = ctx.sql("select v from base where v > (select min(v) from base)")
+    ctx.register_table("big", df)
+    assert sorted(df.collect()["v"]) == [2, 3]  # mutates df's own plan
+    out1 = ctx.sql("select v from big order by v").collect()
+    assert list(out1["v"]) == [2, 3]
+    # re-registering the base name does NOT rebind the view (pinned
+    # source), and must not break or contaminate it either
+    ctx.register_memtable("base", schema(("v", Int64)), {"v": [10, 20, 30]})
+    out2 = ctx.sql("select v from big order by v").collect()
+    assert list(out2["v"]) == [2, 3]
+    # ...while new queries against the re-registered base see new data
+    out3 = ctx.sql("select min(v) as m from base").collect()
+    assert out3["m"][0] == 10
+
+
+def test_view_guard_only_fires_on_table_position():
+    from ballista_tpu.distributed.client import _sql_references_table
+
+    assert _sql_references_table("select * from total", "total")
+    assert _sql_references_table("select * from t join total on a=b", "total")
+    assert _sql_references_table("select * from t, total", "total")
+    assert _sql_references_table("SELECT * FROM TOTAL", "total")
+    # alias / string literal / unrelated ident must not fire
+    assert not _sql_references_table("select sum(v) as total from t", "total")
+    assert not _sql_references_table("select 'total' from t", "total")
+    assert not _sql_references_table("select f(a, total) from t", "total")
+    assert not _sql_references_table("select * from totals", "total")

@@ -111,3 +111,16 @@ def test_sql_error_surfaces_as_flight_error(sql_server):
     with pytest.raises(paflight.FlightError):
         client.do_get(
             paflight.Ticket(b"select nope from missing_table")).read_all()
+
+
+def test_timestamp_ns_precision_preserved(sql_server):
+    """to_timestamp results must not be truncated to day precision on
+    the Flight wire (timestamps carry time-of-day)."""
+    ctx, port = sql_server
+    ctx.register_memtable(
+        "tstab", schema(("s", Utf8)), {"s": ["2024-01-02T10:30:45"]})
+    client = paflight.connect(f"grpc://127.0.0.1:{port}")
+    reader = client.do_get(paflight.Ticket(
+        b"select to_timestamp(s) as t from tstab"))
+    got = reader.read_all().to_pandas()
+    assert str(got["t"][0]) == "2024-01-02 10:30:45"
