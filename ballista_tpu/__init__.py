@@ -35,9 +35,30 @@ if _os.environ.get("JAX_PLATFORMS"):
 # pipelines hit the disk cache across queries, operator instances, AND
 # processes (per-shape recompilation was the dominant first-run cost; see
 # benchmarks/RESULTS.md). Opt out with BALLISTA_XLA_CACHE="".
+
+
+def _machine_tag() -> str:
+    """XLA's CPU cache key does NOT include host CPU features, so AOT
+    results compiled on one machine load on another and can SIGILL (they
+    at minimum spam loader warnings). Version the cache dir by a
+    fingerprint of the host's CPU flags so a moved home dir / changed
+    host gets a fresh cache instead of stale native code."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    import hashlib
+
+                    return hashlib.sha1(line.encode()).hexdigest()[:10]
+    except OSError:
+        pass
+    return "generic"
+
+
 _cache_dir = _os.environ.get(
     "BALLISTA_XLA_CACHE",
-    _os.path.join(_os.path.expanduser("~"), ".cache", "ballista-tpu-xla"),
+    _os.path.join(_os.path.expanduser("~"), ".cache",
+                  f"ballista-tpu-xla-{_machine_tag()}"),
 )
 if _cache_dir:
     try:

@@ -1,0 +1,109 @@
+"""Subprocess spawning for process-level tests, with pipe draining.
+
+Child processes (scheduler/executor binaries, SPMD workers) can emit
+arbitrarily much output — XLA warning spam alone can exceed the 64 KB
+OS pipe buffer. A child that blocks on a full pipe write never answers
+RPCs again and the test times out far from the cause, so every spawned
+process gets a daemon reader thread that continuously drains stdout
+into memory; tests wait on startup lines through `wait_for` instead of
+reading the pipe directly.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+class DrainedProc:
+    """A Popen wrapper whose stdout is drained on a background thread."""
+
+    def __init__(self, popen: subprocess.Popen):
+        self.popen = popen
+        self._lines: List[str] = []
+        self._cond = threading.Condition()
+        self._eof = False
+        t = threading.Thread(target=self._drain, daemon=True)
+        t.start()
+
+    def _drain(self) -> None:
+        for line in self.popen.stdout:
+            with self._cond:
+                self._lines.append(line)
+                self._cond.notify_all()
+        with self._cond:
+            self._eof = True
+            self._cond.notify_all()
+
+    def wait_for(self, pred: Callable[[str], bool],
+                 timeout: float = 90.0) -> str:
+        """Block until a drained line satisfies ``pred``; returns it.
+
+        Raises AssertionError with the full captured output on timeout
+        or child exit, so failures point at the child's real error."""
+        deadline = time.time() + timeout
+        seen = 0
+        with self._cond:
+            while True:
+                while seen < len(self._lines):
+                    if pred(self._lines[seen]):
+                        return self._lines[seen]
+                    seen += 1
+                if self._eof:
+                    raise AssertionError(
+                        "process exited before expected output:\n"
+                        + self.text[-4000:])
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise AssertionError(
+                        "timeout waiting for expected output:\n"
+                        + self.text[-4000:])
+                self._cond.wait(min(remaining, 1.0))
+
+    def wait_exit(self, timeout: float = 180.0) -> int:
+        """Wait for process exit (output keeps draining); returns rc."""
+        deadline = time.time() + timeout
+        rc = self.popen.wait(timeout=timeout)
+        with self._cond:
+            # EOF may lag exit if a descendant inherited the pipe; honor
+            # the caller's deadline rather than waiting forever
+            while not self._eof and time.time() < deadline:
+                self._cond.wait(1.0)
+        return rc
+
+    @property
+    def text(self) -> str:
+        with self._cond:
+            return "".join(self._lines)
+
+    # pass-throughs used by test teardown
+    def poll(self):
+        return self.popen.poll()
+
+    def send_signal(self, sig):
+        return self.popen.send_signal(sig)
+
+    def wait(self, timeout=None):
+        return self.popen.wait(timeout=timeout)
+
+    def kill(self):
+        return self.popen.kill()
+
+
+def spawn_module(args, env) -> DrainedProc:
+    """``python -m <args>`` with stdout+stderr drained."""
+    return DrainedProc(subprocess.Popen(
+        [sys.executable, "-m"] + args, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    ))
+
+
+def spawn_script(argv, env) -> DrainedProc:
+    """``python -c <script> ...`` (or any argv after python) drained."""
+    return DrainedProc(subprocess.Popen(
+        [sys.executable] + argv, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    ))

@@ -7,20 +7,12 @@ import os
 import re
 import signal
 import subprocess
-import sys
-import time
 
 import numpy as np
 import pytest
 
 from ballista_tpu import schema, Int64, Utf8
-
-
-def _spawn(args, env):
-    return subprocess.Popen(
-        [sys.executable, "-m"] + args, env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-    )
+from tests.procutil import spawn_module as _spawn
 
 
 def test_binaries_end_to_end(tmp_path):
@@ -35,7 +27,7 @@ def test_binaries_end_to_end(tmp_path):
         sched = _spawn(["ballista_tpu.distributed.scheduler_main",
                         "--bind-host", "localhost", "--port", "0"], env)
         procs.append(sched)
-        line = sched.stdout.readline()
+        line = sched.wait_for(lambda ln: "listening on" in ln)
         m = re.search(r"listening on [^:]+:(\d+)", line)
         assert m, f"no port in scheduler output: {line!r}"
         port = int(m.group(1))
@@ -47,8 +39,7 @@ def test_binaries_end_to_end(tmp_path):
                         "--work-dir", str(tmp_path / f"w{i}"),
                         "--num-devices", "1"], env)
             procs.append(e)
-            out = e.stdout.readline()
-            assert "polling" in out, out
+            e.wait_for(lambda ln: "polling" in ln)
 
         data = tmp_path / "t.tbl"
         data.write_text("".join(f"{i}|k{i % 3}|\n" for i in range(90)))
@@ -98,10 +89,10 @@ def test_flight_frontend_against_real_cluster(tmp_path):
                         "--bind-host", "localhost", "--port", "0",
                         "--flight-port", "0"], env)
         procs.append(sched)
-        line = sched.stdout.readline()
+        line = sched.wait_for(lambda ln: "listening on" in ln)
         m = re.search(r"listening on [^:]+:(\d+)", line)
         assert m, f"no port in scheduler output: {line!r}"
-        fline = sched.stdout.readline()
+        fline = sched.wait_for(lambda ln: "Flight SQL endpoint on" in ln)
         fm = re.search(r"Flight SQL endpoint on [^:]+:(\d+)", fline)
         assert fm, f"no flight port in scheduler output: {fline!r}"
         fport = int(fm.group(1))
@@ -112,7 +103,7 @@ def test_flight_frontend_against_real_cluster(tmp_path):
                     "--work-dir", str(tmp_path / "w0"),
                     "--num-devices", "1"], env)
         procs.append(e)
-        assert "polling" in e.stdout.readline()
+        e.wait_for(lambda ln: "polling" in ln)
 
         data = tmp_path / "t.tbl"
         data.write_text("".join(f"{i}|k{i % 3}|\n" for i in range(60)))

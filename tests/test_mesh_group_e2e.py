@@ -14,14 +14,13 @@ import re
 import signal
 import socket
 import subprocess
-import sys
-import time
 
 import numpy as np
 import pandas as pd
 import pytest
 
 from ballista_tpu import Int64, Utf8, schema
+from tests.procutil import spawn_module as _spawn
 
 
 def _free_port():
@@ -30,13 +29,6 @@ def _free_port():
     p = s.getsockname()[1]
     s.close()
     return p
-
-
-def _spawn(args, env):
-    return subprocess.Popen(
-        [sys.executable, "-m"] + args, env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-    )
 
 
 @pytest.mark.sf02  # heavyweight: spawns a 3-process cluster
@@ -66,7 +58,7 @@ def test_fused_aggregation_across_process_mesh(tmp_path):
         sched = _spawn(["ballista_tpu.distributed.scheduler_main",
                         "--bind-host", "localhost", "--port", "0"], env)
         procs.append(sched)
-        line = sched.stdout.readline()
+        line = sched.wait_for(lambda ln: "listening on" in ln)
         m = re.search(r"listening on [^:]+:(\d+)", line)
         assert m, f"no port in scheduler output: {line!r}"
         sport = m.group(1)
@@ -87,15 +79,8 @@ def test_fused_aggregation_across_process_mesh(tmp_path):
         procs.append(follower)
 
         # leader prints its polling line only after the follower joined
-        deadline = time.time() + 90
-        polling = ""
-        seen = []
-        while time.time() < deadline:
-            polling = leader.stdout.readline()
-            seen.append(polling)
-            if "polling" in polling or not polling:
-                break
-        assert "mesh group of 2 x 4 devices" in polling, "".join(seen)
+        polling = leader.wait_for(lambda ln: "polling" in ln, timeout=90)
+        assert "mesh group of 2 x 4 devices" in polling, leader.text
 
         from ballista_tpu.client import BallistaContext
         from ballista_tpu.io import TblSource

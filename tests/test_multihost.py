@@ -115,23 +115,23 @@ def test_cross_process_mesh_shuffle_aggregation(tmp_path):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    from tests.procutil import spawn_script
+
+    # drained spawns: either worker can exceed the OS pipe buffer with
+    # XLA warning spam, and a worker blocked on a pipe write stalls the
+    # whole collective (both processes are in the same all_to_all)
     procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", script, str(i), str(nprocs), str(port)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
-        )
+        spawn_script(["-c", script, str(i), str(nprocs), str(port)], env)
         for i in range(nprocs)
     ]
-    outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=180)
-            outs.append(out)
+            p.wait_exit(timeout=180)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"process {i} failed:\n{out[-2000:]}"
+    for i, p in enumerate(procs):
+        out = p.text
+        assert p.popen.returncode == 0, f"process {i} failed:\n{out[-2000:]}"
         assert f"MULTIHOST_OK p{i}" in out, out[-2000:]
