@@ -99,22 +99,63 @@ class JoinExec(PhysicalPlan):
                 )
         return keys, live_ext
 
-    def _packable(self, batch: ColumnBatch, cols: List[str]) -> bool:
-        """True when 2-column keys fit the 31/32-bit packing (host check
-        on the build side; out-of-range keys fall back to the codec)."""
-        if len(cols) == 1:
-            return True  # raw values, always exact
-        if len(cols) > 2:
-            return False  # codec handles any column count
-        a = np.asarray(batch.column(cols[0]).values)
-        b = np.asarray(batch.column(cols[1]).values)
-        sel = np.asarray(batch.selection)
-        if not sel.any():
-            return True
-        return not (
-            (np.abs(a[sel]) >= (1 << 31)).any() or (b[sel] < 0).any()
-            or (b[sel] >= (1 << 32) - 1).any()
-        )
+    # Dense direct-index mode limits: table entries are int32 rows; cap
+    # the table at 16M entries (64 MB HBM) and at 8x the build capacity
+    # so pathological sparse keys (e.g. hash-like ids) stay on the
+    # sorted path.
+    _DENSE_MAX_SIZE = 1 << 24
+    _DENSE_FACTOR = 8
+
+    def _build_stats(self, bb: ColumnBatch, cols: List[str]):
+        """ONE jitted program -> (host scalars, device live mask): per-col
+        min/max over selected rows, live-key min/max for the first col,
+        null-key flag. Only the scalars cross to host — replaces the old
+        host-side full-column pulls, which over a slow host<->device link
+        cost more than the join itself. The combined live mask stays on
+        device for the build to reuse (it is exactly the
+        selection & key-validity reduction the raw/packed paths need)."""
+
+        def stats(bb):
+            live_ext = self._key_live_ext(bb, cols)
+            live = bb.selection
+            if live_ext is not None:
+                live = jnp.logical_and(live, live_ext)
+                has_null = jnp.any(jnp.logical_and(
+                    bb.selection, jnp.logical_not(live_ext)))
+            else:
+                has_null = jnp.asarray(False)
+            out = {"has_null": has_null,
+                   "nlive": jnp.sum(live.astype(jnp.int32))}
+            maxi = jnp.iinfo(jnp.int64).max
+            for i, c in enumerate(cols):
+                v = bb.column(c).values.astype(jnp.int64)
+                out[f"sel_min_{i}"] = jnp.min(
+                    jnp.where(bb.selection, v, maxi))
+                out[f"sel_max_{i}"] = jnp.max(
+                    jnp.where(bb.selection, v, -maxi))
+            v0 = bb.column(cols[0]).values.astype(jnp.int64)
+            out["live_min"] = jnp.min(jnp.where(live, v0, maxi))
+            out["live_max"] = jnp.max(jnp.where(live, v0, -maxi))
+            return out, live
+
+        key = ("stats", bb.capacity)
+        if key not in self._jit_probe:
+            self._jit_probe[key] = jax.jit(stats)
+        scalars, live = self._jit_probe[key](bb)
+        return jax.device_get(scalars), live
+
+    def _pick_mode(self, stats, ncols: int) -> str:
+        if ncols == 1:
+            return "raw"
+        if ncols > 2:
+            return "codec"  # codec handles any column count
+        amin, amax = int(stats["sel_min_0"]), int(stats["sel_max_0"])
+        bmin, bmax = int(stats["sel_min_1"]), int(stats["sel_max_1"])
+        if amin > amax:
+            return "packed"  # no selected rows: any representation works
+        packable = (max(abs(amin), abs(amax)) < (1 << 31)
+                    and bmin >= 0 and bmax < (1 << 32) - 1)
+        return "packed" if packable else "codec"
 
     def _key_live_ext(self, batch: ColumnBatch, cols: List[str]):
         live_ext = None
@@ -229,30 +270,49 @@ class JoinExec(PhysicalPlan):
                 raise ExecutionError("join build side produced no batches")
         bb = concat_batches(self.build.output_schema(), batches)
         bcols = [b for b, _ in self.on]
-        live_ext = self._key_live_ext(bb, bcols)
-        has_null_key = False
-        if live_ext is not None:
-            has_null_key = bool(
-                np.any(np.asarray(bb.selection) & ~np.asarray(live_ext))
-            )
-        if self._packable(bb, bcols):
-            mode = "raw" if len(bcols) == 1 else "packed"
+        stats, stats_live = self._build_stats(bb, bcols)
+        has_null_key = bool(stats["has_null"])
+        nlive = int(stats["nlive"])
+        mode = self._pick_mode(stats, len(bcols))
+        if mode in ("raw", "packed"):
             keys, _ = self._key_of(bb, bcols)
-            live = bb.selection
-            if live_ext is not None:
-                live = jnp.logical_and(live, live_ext)
+            live = stats_live
             key_tables = ()
         else:
-            mode = "codec"
             if bb.capacity not in self._jit_codec_build:
                 self._jit_codec_build[bb.capacity] = jax.jit(
                     lambda b: self._codec_build(b, bcols)
                 )
             keys, live, key_tables = self._jit_codec_build[bb.capacity](bb)
-        table = jax.jit(join_k.build_lookup)(keys, live)
-        sk = np.asarray(table.sorted_keys)
-        nlive = int(table.num_live)
-        unique = not bool(np.any(sk[1 : nlive] == sk[: nlive - 1])) if nlive > 1 else True
+        table = None
+        unique = True
+        if mode == "raw" and nlive > 0:
+            base = int(stats["live_min"])
+            size = int(stats["live_max"]) - base + 1
+            if 0 < size <= min(self._DENSE_MAX_SIZE,
+                               self._DENSE_FACTOR * bb.capacity):
+                # quantize the (static) table size so successive builds
+                # with different key ranges reuse one compiled program;
+                # padding slots stay -1 and can never match
+                size = round_capacity(size)
+                jkey = ("dense", bb.capacity, size)
+                if jkey not in self._jit_probe:
+                    self._jit_probe[jkey] = jax.jit(
+                        join_k.build_dense, static_argnames=("size",))
+                rows, dup = self._jit_probe[jkey](keys, live,
+                                                  jnp.int64(base), size=size)
+                if not bool(dup):
+                    table = join_k.BuildTable(
+                        sorted_keys=None, order=None,
+                        num_live=jnp.asarray(nlive, jnp.int32),
+                        dense_rows=rows, dense_base=jnp.int64(base))
+        if table is None:
+            jkey = ("sorted", bb.capacity)
+            if jkey not in self._jit_probe:
+                self._jit_probe[jkey] = jax.jit(
+                    join_k.build_sorted_with_unique)
+            table, uniq = self._jit_probe[jkey](keys, live)
+            unique = bool(uniq)
         self._build_data[key] = (table, bb, unique, has_null_key, mode,
                                  key_tables, keys, live)
         return self._build_data[key]

@@ -25,7 +25,7 @@ def main():
     ap.add_argument("--query", default="q5")
     ap.add_argument("--data", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "benchmarks", "bench_data", "sf1"))
+        "bench_data", "sf1"))
     ap.add_argument("--runs", type=int, default=2)
     args = ap.parse_args()
 

@@ -213,3 +213,77 @@ def test_join_expand():
         for p, b, l in zip(np.asarray(prow), np.asarray(brow), ol) if l
     )
     assert got == [(1, 1), (1, 1), (2, 2)]
+
+
+def test_narrow_wire_upload_exact():
+    """Narrow-on-wire transfer must be value-exact incl. negatives and
+    int8/int16/int32 boundary values, and must widen back to the
+    logical device dtype."""
+    import os
+
+    import jax.numpy as jnp
+
+    from ballista_tpu import columnar as col_mod
+    from ballista_tpu.columnar import ColumnBatch
+    from ballista_tpu.datatypes import Int64, Schema, Field
+
+    old = col_mod._NARROW_WIRE
+    col_mod._NARROW_WIRE = True
+    try:
+        sch = Schema([Field("a", Int64), Field("b", Int64), Field("c", Int64)])
+        data = {
+            "a": np.array([-128, 127, 0], np.int64),          # int8 fits
+            "b": np.array([-32768, 32767, 5], np.int64),      # int16 fits
+            "c": np.array([2**40, -2**40, 1], np.int64),      # no narrowing
+        }
+        b = ColumnBatch.from_numpy(sch, data)
+        for name in data:
+            c = b.column(name)
+            assert c.values.dtype == jnp.int64
+            np.testing.assert_array_equal(
+                np.asarray(c.values)[:3], data[name])
+    finally:
+        col_mod._NARROW_WIRE = old
+
+
+def test_join_dense_probe_exact():
+    """Dense direct-index probe must match the sorted probe bit-for-bit,
+    including negatives, range boundaries, and out-of-range probe keys."""
+    import jax.numpy as jnp
+
+    from ballista_tpu.kernels import join as join_k
+
+    bk = jnp.asarray(np.array([-5, -2, 0, 7, 12], np.int64))
+    bl = jnp.asarray(np.array([True, True, False, True, True]))
+    rows, dup = join_k.build_dense(bk, bl, jnp.int64(-5), 18)
+    assert not bool(dup)
+    table = join_k.BuildTable(
+        sorted_keys=None, order=None, num_live=jnp.int32(4),
+        dense_rows=rows, dense_base=jnp.int64(-5))
+    sorted_table = join_k.build_lookup(bk, bl)
+    pk = jnp.asarray(np.array([-5, -2, 0, 7, 12, -6, 13, 999, -999], np.int64))
+    pl = jnp.ones(9, bool)
+    r_dense, m_dense = join_k.probe_unique(table, pk, pl)
+    r_sorted, m_sorted = join_k.probe_unique(sorted_table, pk, pl)
+    np.testing.assert_array_equal(np.asarray(m_dense), np.asarray(m_sorted))
+    # matched rows must point at the same build rows
+    md = np.asarray(m_dense)
+    np.testing.assert_array_equal(np.asarray(r_dense)[md],
+                                  np.asarray(r_sorted)[md])
+    # dead build row (key 0) must not match
+    assert not np.asarray(m_dense)[2]
+
+
+def test_join_dense_detects_duplicates():
+    import jax.numpy as jnp
+
+    from ballista_tpu.kernels import join as join_k
+
+    bk = jnp.asarray(np.array([3, 3, 5], np.int64))
+    bl = jnp.ones(3, bool)
+    _, dup = join_k.build_dense(bk, bl, jnp.int64(3), 3)
+    assert bool(dup)
+    # duplicates hidden by the live mask don't count
+    bl2 = jnp.asarray(np.array([True, False, True]))
+    _, dup2 = join_k.build_dense(bk, bl2, jnp.int64(3), 3)
+    assert not bool(dup2)
