@@ -340,6 +340,69 @@ def _dense_grouped_xla(
                          valid_results)
 
 
+def dense_grouped_scatter(
+    gids: jax.Array,  # int32 [N] in [0, num_groups)
+    live: jax.Array,  # bool [N]
+    aggs: Sequence[AggInput],
+    num_groups: int,
+) -> GroupedResult:
+    """O(N) scatter-based dense grouping for group counts where
+    ``_dense_grouped_xla``'s [N, G] membership product is prohibitive
+    (ranged-integer keys: thousands to millions of groups). Same
+    semantics: non-compact groups, ``group_valid`` marks occupancy,
+    per-aggregate validity is "any non-NULL input seen"."""
+    n = gids.shape[0]
+    G = num_groups
+    slot = jnp.where(live, gids, G).astype(jnp.int32)  # dead -> dropped
+    rows = jnp.arange(n, dtype=jnp.int32)
+    first = jnp.full((G,), n, jnp.int32).at[slot].min(rows, mode="drop")
+    group_valid = first < n
+    rep_indices = jnp.minimum(first, n - 1)
+    num_present = jnp.sum(group_valid.astype(jnp.int32))
+
+    results: List[jax.Array] = []
+    valid_results: List[jax.Array] = []
+    for a in aggs:
+        valid = a.validity
+        if a.op == "count":
+            v = jnp.ones((n,), jnp.int64)
+            if valid is not None:
+                v = jnp.where(valid, v, 0)
+            r = jnp.zeros((G,), jnp.int64).at[slot].add(v, mode="drop")
+            va = group_valid
+        else:
+            if a.values is None:
+                raise ExecutionError(f"{a.op} requires input values")
+            v = a.values
+            if a.op == "sum":
+                if valid is not None:
+                    v = jnp.where(valid, v, jnp.zeros((), v.dtype))
+                r = jnp.zeros((G,), v.dtype).at[slot].add(v, mode="drop")
+            elif a.op == "min":
+                if valid is not None:
+                    v = jnp.where(valid, v, _max_ident(v.dtype))
+                r = jnp.full((G,), _max_ident(v.dtype), v.dtype) \
+                    .at[slot].min(v, mode="drop")
+            elif a.op == "max":
+                if valid is not None:
+                    v = jnp.where(valid, v, _min_ident(v.dtype))
+                r = jnp.full((G,), _min_ident(v.dtype), v.dtype) \
+                    .at[slot].max(v, mode="drop")
+            else:
+                raise ExecutionError(f"unknown aggregate op {a.op}")
+            if valid is not None:
+                seen = jnp.zeros((G,), jnp.int32).at[slot].max(
+                    valid.astype(jnp.int32), mode="drop")
+                va = jnp.logical_and(group_valid, seen > 0)
+            else:
+                va = group_valid
+        results.append(jnp.where(va, r, jnp.zeros((), r.dtype)))
+        valid_results.append(va)
+
+    return GroupedResult(rep_indices, group_valid, num_present, results,
+                         valid_results)
+
+
 def _dense_grouped_pallas(gids, live, aggs, num_groups,
                           interpret: bool) -> GroupedResult:
     """Integer sums/counts via the fused Pallas kernel

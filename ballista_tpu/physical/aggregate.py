@@ -29,6 +29,7 @@ from ..kernels.aggregate import (
     AggInput,
     avg_fixed,
     dense_grouped_aggregate,
+    dense_grouped_scatter,
     grouped_aggregate,
     scalar_aggregate,
 )
@@ -101,6 +102,7 @@ class HashAggregateExec(PhysicalPlan):
             if not isinstance(a, ex.AggregateExpr):
                 raise ExecutionError(f"not an aggregate expression: {name}")
         self._jit_cache = {}
+        self._ranged_rejected: set = set()
 
     # -- schemas ------------------------------------------------------------
 
@@ -289,12 +291,81 @@ class HashAggregateExec(PhysicalPlan):
             g *= card + (1 if col.validity is not None else 0)
         return g if g > 0 else None
 
+    # Ranged-integer dense grouping: a single plain integer group key
+    # whose live value range fits below these bounds aggregates by O(N)
+    # scatter into a [range] table — no sort, no overflow retry. The
+    # range cap bounds table memory; the capacity factor keeps
+    # pathological sparse keys (hash-like ids) on the sort path.
+    _RANGED_DENSE_LIMIT = 1 << 23
+    _RANGED_CAP_FACTOR = 4
+    _RANGED_KINDS = ("int32", "int64", "decimal", "date32", "timestamp_ns")
+
+    def _ranged_key_name(self, batch: ColumnBatch) -> Optional[str]:
+        """Column name when the group key is ONE plain integer-physical
+        column without a dictionary (dictionaries take the dense path
+        on cardinality; expressions would need evaluation first)."""
+        if len(self.group_exprs) != 1:
+            return None
+        e = self.group_exprs[0]
+        if self.mode == "partial":
+            base = ex.strip_alias(e)
+            if not isinstance(base, ex.ColumnRef):
+                return None
+            name = base.column
+        else:
+            name = e.name()
+        try:
+            col = batch.column(name)
+        except Exception:  # noqa: BLE001 - unknown column: not eligible
+            return None
+        if col.dictionary is not None or col.dtype.kind not in self._RANGED_KINDS:
+            return None
+        return name
+
+    def _key_range_stats(self, batch: ColumnBatch, name: str):
+        """(kmin, kmax, nlive) of the key over live rows, one jitted
+        program, scalars only across the link."""
+        key = ("rstats", name, batch.capacity)
+        if key not in self._jit_cache:
+
+            def stats(b):
+                c = b.column(name)
+                v = c.values.astype(jnp.int64)
+                live = b.selection
+                if c.validity is not None:
+                    live = jnp.logical_and(live, c.validity)
+                maxi = jnp.iinfo(jnp.int64).max
+                return (jnp.min(jnp.where(live, v, maxi)),
+                        jnp.max(jnp.where(live, v, -maxi)),
+                        jnp.sum(live.astype(jnp.int32)))
+
+            self._jit_cache[key] = jax.jit(stats)
+        kmin, kmax, nlive = jax.device_get(self._jit_cache[key](batch))
+        return int(kmin), int(kmax), int(nlive)
+
     def _exec_grouped(self, batch: ColumnBatch) -> ColumnBatch:
         cap = self.group_capacity
         bound = self._static_group_bound(batch)
         if bound is not None and bound <= min(DENSE_GROUP_LIMIT, cap):
             out, _ng = self._get_grouped_fn(cap, batch.capacity)(batch)
             return out  # dense path, can't overflow: no sync needed
+        name = self._ranged_key_name(batch)
+        # a column rejected once (hash-like sparse ids) is rejected for
+        # the operator's lifetime: don't pay the stats round-trip again
+        if name is not None and name not in self._ranged_rejected:
+            kmin, kmax, nlive = self._key_range_stats(batch, name)
+            span = kmax - kmin + 2  # +1 slot for NULL keys at gid 0
+            # admission gates on LIVE rows (not capacity): sparse
+            # post-filter batches must not allocate huge group tables
+            if 0 < span - 1 and span <= min(self._RANGED_DENSE_LIMIT,
+                                            self._RANGED_CAP_FACTOR
+                                            * (nlive + 256)):
+                G = round_capacity(span)
+                fn = self._get_ranged_fn(G, batch.capacity, name)
+                out, _ng = fn(batch, jnp.int64(kmin))
+                return out  # gid < G by construction: no overflow sync
+            if span - 1 > 0:  # a real range that failed the bound
+                self._ranged_rejected.add(name)
         while True:
             fn = self._get_grouped_fn(cap, batch.capacity)
             out, num_groups = fn(batch)
@@ -303,48 +374,79 @@ class HashAggregateExec(PhysicalPlan):
                 return out
             cap = round_capacity(ng)
 
+    def _inputs_and_keys(self, batch: ColumnBatch):
+        """(key_evals, aggs) for the current mode. Traced."""
+        if self.mode == "partial":
+            key_evals = [self._ev.evaluate(e, batch) for e in self.group_exprs]
+            aggs = self._agg_inputs_partial(batch)
+        else:
+            key_evals = [
+                self._ev.evaluate(ex.ColumnRef(e.name()), batch)
+                for e in self.group_exprs
+            ]
+            aggs = self._agg_inputs_final(batch)
+        return key_evals, aggs
+
+    def _assemble(self, batch: ColumnBatch, key_evals, res, cap: int):
+        """GroupedResult -> output ColumnBatch. Traced."""
+        out_cols: List[Column] = []
+        gf = self.group_fields()
+        for f, r in zip(gf, key_evals):
+            vals = jnp.take(
+                jnp.broadcast_to(r.values, (batch.capacity,)),
+                res.rep_indices,
+            )
+            validity = (
+                jnp.take(r.validity, res.rep_indices)
+                if r.validity is not None
+                else None
+            )
+            out_cols.append(Column(vals, f.dtype, validity, r.dictionary))
+        if self.mode == "partial":
+            for (name, op, dt), arr, va in zip(
+                self.state_fields(), res.aggregates, res.agg_valid
+            ):
+                out_cols.append(Column(arr, dt, va, None))
+        else:
+            out_cols.extend(self._finalize(res))
+        return ColumnBatch(
+            self.output_schema(), out_cols, res.group_valid,
+            jnp.minimum(res.num_groups, cap),
+        )
+
     def _get_grouped_fn(self, cap: int, in_cap: int):
         key = ("grouped", self.mode, cap, in_cap)
         if key not in self._jit_cache:
 
             def run(batch: ColumnBatch):
-                if self.mode == "partial":
-                    key_evals = [self._ev.evaluate(e, batch) for e in self.group_exprs]
-                    aggs = self._agg_inputs_partial(batch)
-                else:
-                    key_evals = [
-                        self._ev.evaluate(ex.ColumnRef(e.name()), batch)
-                        for e in self.group_exprs
-                    ]
-                    aggs = self._agg_inputs_final(batch)
+                key_evals, aggs = self._inputs_and_keys(batch)
                 res = self._run_grouping(batch, key_evals, aggs, cap)
-                out_cols: List[Column] = []
-                gf = self.group_fields()
-                for f, r in zip(gf, key_evals):
-                    vals = jnp.take(
-                        jnp.broadcast_to(r.values, (batch.capacity,)),
-                        res.rep_indices,
-                    )
-                    validity = (
-                        jnp.take(r.validity, res.rep_indices)
-                        if r.validity is not None
-                        else None
-                    )
-                    out_cols.append(Column(vals, f.dtype, validity, r.dictionary))
-                if self.mode == "partial":
-                    for (name, op, dt), arr, va in zip(
-                        self.state_fields(), res.aggregates, res.agg_valid
-                    ):
-                        out_cols.append(Column(arr, dt, va, None))
-                    schema = self.output_schema()
-                else:
-                    out_cols.extend(self._finalize(res))
-                    schema = self.output_schema()
-                out_batch = ColumnBatch(
-                    schema, out_cols, res.group_valid,
-                    jnp.minimum(res.num_groups, cap),
-                )
-                return out_batch, res.num_groups
+                return self._assemble(batch, key_evals, res, cap), \
+                    res.num_groups
+
+            self._jit_cache[key] = jax.jit(run)
+        return self._jit_cache[key]
+
+    def _get_ranged_fn(self, G: int, in_cap: int, name: str):
+        """Grouping program for ONE integer key whose live values fit in
+        [base, base+G): gid = key - base + 1 (slot 0 = NULL keys), O(N)
+        scatter aggregation, no sort and no overflow. ``base`` is a
+        traced argument so consecutive batches with different ranges but
+        the same quantized span reuse one compiled program."""
+        key = ("ranged", self.mode, G, in_cap, name)
+        if key not in self._jit_cache:
+
+            def run(batch: ColumnBatch, base):
+                key_evals, aggs = self._inputs_and_keys(batch)
+                r = key_evals[0]
+                k = jnp.broadcast_to(r.values, (batch.capacity,)) \
+                    .astype(jnp.int64)
+                gid = (k - base + 1).astype(jnp.int32)
+                if r.validity is not None:
+                    gid = jnp.where(r.validity, gid, 0)
+                res = dense_grouped_scatter(gid, batch.selection, aggs, G)
+                return self._assemble(batch, key_evals, res, G), \
+                    res.num_groups
 
             self._jit_cache[key] = jax.jit(run)
         return self._jit_cache[key]

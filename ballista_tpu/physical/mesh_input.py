@@ -114,13 +114,11 @@ def _apply_remaps(schema: Schema, b: ColumnBatch, remap_row, dicts
 def _compact_impl(big: ColumnBatch, cap: int) -> ColumnBatch:
     """Gather live rows to the front of a [cap] batch (validity
     materialized so every slot shares one pytree structure). Traced."""
+    from .base import compact_perm
+
     n = big.capacity
-    dead = jnp.logical_not(big.selection)
-    idx = jnp.arange(n, dtype=jnp.int32)
-    _, perm = jax.lax.sort((dead, idx), num_keys=1, is_stable=True)
-    if cap <= n:
-        perm = perm[:cap]
-    else:
+    perm = compact_perm(big.selection, min(cap, n))
+    if cap > n:
         perm = jnp.concatenate(
             [perm, jnp.zeros((cap - n,), jnp.int32)]
         )

@@ -138,6 +138,20 @@ def _create(plan: LogicalPlan, opts: PlannerOptions) -> PhysicalPlan:
         if plan.how == "inner":
             build, probe, how = left, right, "inner"
             on = list(plan.on)
+            # inner is symmetric and the projection below restores column
+            # order, so build on the smaller estimated side: the build is
+            # merged/sorted/tabled in full, and a small unique build side
+            # keeps probes on the cheap non-expanding path. Skip the swap
+            # when the sides share column names: JoinExec resolves name
+            # collisions in favor of the build side, so swapping would
+            # change which side's values a collided name refers to.
+            le, re_ = left.estimated_rows(), right.estimated_rows()
+            collide = (set(left.output_schema().names())
+                       & set(right.output_schema().names()))
+            if (not collide and le is not None and re_ is not None
+                    and re_ < le):
+                build, probe = right, left
+                on = [(r, l) for l, r in plan.on]
         elif plan.how == "left":
             build, probe, how = right, left, "left"
             on = [(r, l) for l, r in plan.on]
