@@ -241,6 +241,24 @@ def maybe_compact(batch: ColumnBatch, shrink_factor: int = 4,
     return _COMPACT_JITS[key](batch)
 
 
+def pad_batch(batch: ColumnBatch, capacity: int) -> ColumnBatch:
+    """Grow a batch's capacity with dead padding rows (device)."""
+    if capacity <= batch.capacity:
+        return batch
+    extra = capacity - batch.capacity
+    cols = []
+    for col in batch.columns:
+        vals = jnp.concatenate(
+            [col.values, jnp.zeros((extra,), col.values.dtype)])
+        validity = (
+            jnp.concatenate([col.validity, jnp.zeros((extra,), jnp.bool_)])
+            if col.validity is not None else None)
+        cols.append(Column(vals, col.dtype, validity, col.dictionary))
+    selection = jnp.concatenate(
+        [batch.selection, jnp.zeros((extra,), jnp.bool_)])
+    return ColumnBatch(batch.schema, cols, selection, batch.num_rows)
+
+
 def compact_perm(selection: jax.Array, size: int) -> jax.Array:
     """Gather permutation putting live rows first, in order: stable
     front-compaction via static-size nonzero (cumsum + scatter, O(N)) —

@@ -25,7 +25,8 @@ from ..kernels.expr_eval import Evaluator
 from ..kernels.sort import sort_permutation
 from ..kernels.hashing import splitmix64
 from ..logical import TableSource
-from .base import PhysicalPlan, PipelineOp, Partitioning, concat_batches, take_batch
+from .base import (PhysicalPlan, PipelineOp, Partitioning, concat_batches,
+                   pad_batch, take_batch)
 
 
 def compute_partition_ids(batch: ColumnBatch, hash_exprs, num_partitions: int,
@@ -342,13 +343,17 @@ class RepartitionExec(PhysicalPlan):
         return self._parts
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
-        """Yields COMPACTED batches: rows of the requested partition are
-        gathered to the front and the capacity shrinks to fit, so a
-        partitioned consumer (e.g. a co-partitioned join) does 1/N the
-        work per partition instead of re-touching full-capacity masked
-        batches. Mirrors the distributed path, where shuffle files are
-        mask-compacted on IPC write."""
+        """Yields ONE COMPACTED batch: rows of the requested partition are
+        gathered to the front of a capacity that fits, so a partitioned
+        consumer (e.g. a co-partitioned join) does 1/N the work per
+        partition instead of re-touching full-capacity masked batches.
+        Per-source fragments are coalesced so a multi-file scan times N
+        buckets doesn't fan out into source*N fragments, each paying
+        per-batch dispatch and assembly downstream. Mirrors the
+        distributed path, where shuffle files are mask-compacted on IPC
+        write."""
         self._jit_take = getattr(self, "_jit_take", {})
+        pieces = []
         for batch, perm, counts in self._materialize_parts():
             n = int(counts[partition])
             start = int(counts[:partition].sum())
@@ -366,7 +371,18 @@ class RepartitionExec(PhysicalPlan):
                     return take_batch(b, idx, live)
 
                 self._jit_take[key] = jax.jit(take_front)
-            yield self._jit_take[key](batch, idx, jnp.int32(n))
+            pieces.append(self._jit_take[key](batch, idx, jnp.int32(n)))
+        if len(pieces) == 1:
+            yield pieces[0]
+        elif pieces:
+            out = concat_batches(self.output_schema(), pieces)
+            # concat of power-of-two pieces isn't itself a power of two
+            # (128+64=192); pad up so downstream per-capacity jit caches
+            # reuse one compiled program across output partitions
+            target = round_capacity(out.capacity)
+            if target != out.capacity:
+                out = pad_batch(out, target)
+            yield out
 
     def display(self) -> str:
         k = "hash" if self.hash_exprs else "round-robin"

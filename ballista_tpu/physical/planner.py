@@ -8,6 +8,7 @@ aggregate split and probe/build side selection for joins.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Dict, Optional
 
@@ -52,8 +53,15 @@ class PlannerOptions:
     ``join_partitions``: partition count for such shuffled joins.
     """
 
-    join_partition_threshold: Optional[int] = 4_000_000
+    # build side is the SMALLER estimated side for merged inner joins
+    # (they swap), so this gates on the min side: above it,
+    # co-partitioned buckets beat a merged build, whose concat+table
+    # rebuild repeats per query run
+    join_partition_threshold: Optional[int] = 1_000_000
     join_partitions: int = 8
+    # cost-based inner-join orientation (see the swap block below);
+    # settings key "join.swap", env BALLISTA_JOIN_SWAP as default source
+    join_swap: bool = True
     # hash-shuffled aggregation: partial -> Repartition(hash on group
     # keys) -> final, instead of merging all partial tables to one task.
     # None keeps the merge plan; N produces an N-partition final stage
@@ -71,6 +79,15 @@ class PlannerOptions:
             )
         if "join.partitions" in s:
             opts.join_partitions = int(s["join.partitions"])
+        swap = s.get("join.swap",
+                     os.environ.get("BALLISTA_JOIN_SWAP", "on")).lower()
+        if swap in ("off", "0", "false"):
+            opts.join_swap = False
+        elif swap not in ("on", "1", "true", ""):
+            import logging
+
+            logging.getLogger("ballista.planner").warning(
+                "unrecognized join.swap value %r; keeping swap ON", swap)
         if "agg.partitions" in s:
             v = s["agg.partitions"]
             opts.agg_partitions = None if v in ("", "off", "none") else int(v)
@@ -138,20 +155,6 @@ def _create(plan: LogicalPlan, opts: PlannerOptions) -> PhysicalPlan:
         if plan.how == "inner":
             build, probe, how = left, right, "inner"
             on = list(plan.on)
-            # inner is symmetric and the projection below restores column
-            # order, so build on the smaller estimated side: the build is
-            # merged/sorted/tabled in full, and a small unique build side
-            # keeps probes on the cheap non-expanding path. Skip the swap
-            # when the sides share column names: JoinExec resolves name
-            # collisions in favor of the build side, so swapping would
-            # change which side's values a collided name refers to.
-            le, re_ = left.estimated_rows(), right.estimated_rows()
-            collide = (set(left.output_schema().names())
-                       & set(right.output_schema().names()))
-            if (not collide and le is not None and re_ is not None
-                    and re_ < le):
-                build, probe = right, left
-                on = [(r, l) for l, r in plan.on]
         elif plan.how == "left":
             build, probe, how = right, left, "left"
             on = [(r, l) for l, r in plan.on]
@@ -174,6 +177,27 @@ def _create(plan: LogicalPlan, opts: PlannerOptions) -> PhysicalPlan:
         # per-bucket build would miss nulls that hashed elsewhere
         partitionable = (not plan.null_aware and threshold is not None
                          and how != "full")
+        # Inner joins are symmetric and the projection below restores
+        # column order, so orient by cost (measured on TPC-H, see
+        # benchmarks/RESULTS.md). Co-partitioned mode: build the LARGER
+        # side — output capacities ride the probe side, so probing the
+        # small side keeps every downstream shape small. Merged mode:
+        # build the SMALLER side — the build is concatenated and tabled
+        # whole, and a small unique build keeps probes off the expanding
+        # path. Skipped when the sides share column names (JoinExec
+        # resolves collisions build-first, so a swap would change which
+        # side a collided name refers to) or estimates are unknown.
+        if plan.how == "inner" and opts.join_swap:
+            le, re_ = build.estimated_rows(), probe.estimated_rows()
+            collide = (set(build.output_schema().names())
+                       & set(probe.output_schema().names()))
+            if not collide and le is not None and re_ is not None:
+                goes_partitioned = (partitionable
+                                    and min(le, re_) > threshold)
+                want_larger_build = goes_partitioned
+                if (re_ > le) == want_larger_build and re_ != le:
+                    build, probe = probe, build
+                    on = [(p, b) for b, p in on]
         est = build.estimated_rows() if partitionable else None
         if partitionable and est is not None and est > threshold:
             # co-partitioned join: hash-shuffle BOTH sides on the join keys

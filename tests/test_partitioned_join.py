@@ -64,10 +64,11 @@ def test_planner_emits_partitioned_join_above_threshold():
     assert j is not None and j.partitioned
     assert all(isinstance(c, RepartitionExec) for c in j.children())
     assert all(c.num_partitions == 4 for c in j.children())
-    # both sides hash the co-located join key; the SMALLER estimated
-    # side (r, 40 rows vs 100) is chosen as build for inner joins
-    assert [e.name() for e in j.build.hash_exprs] == ["rk"]
-    assert [e.name() for e in j.probe.hash_exprs] == ["lk"]
+    # both sides hash the co-located join key; co-partitioned inner
+    # joins build on the LARGER estimated side (l, 100 rows vs 40) so
+    # output capacities ride the smaller probe side
+    assert [e.name() for e in j.build.hash_exprs] == ["lk"]
+    assert [e.name() for e in j.probe.hash_exprs] == ["rk"]
 
     # below threshold: merged-build join, unchanged
     phys2 = create_physical_plan(plan, PlannerOptions(
