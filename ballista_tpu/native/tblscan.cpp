@@ -40,6 +40,11 @@ struct Column {
   // utf8: raw codes (pre-sort), dictionary arena
   std::unordered_map<std::string, int32_t> dict_map;
   std::vector<std::string> dict_values;
+  // 1-byte values (status flags etc.) hit this O(1) table instead of a
+  // per-row string construction + hash lookup; kept consistent with
+  // dict_map so mixed-length columns stay correct
+  int32_t char1[256];
+  Column() { for (auto& v : char1) v = -1; }
 };
 
 struct Table {
@@ -116,6 +121,13 @@ inline bool parse_field(Column& c, const char* s, const char* e) {
       return true;
     }
     case 4: {  // utf8 dict
+      if (e - s == 1) {
+        int32_t cached = c.char1[static_cast<unsigned char>(*s)];
+        if (cached >= 0) {
+          c.i32.push_back(cached);
+          return true;
+        }
+      }
       std::string key(s, static_cast<size_t>(e - s));
       auto it = c.dict_map.find(key);
       int32_t code;
@@ -126,6 +138,7 @@ inline bool parse_field(Column& c, const char* s, const char* e) {
       } else {
         code = it->second;
       }
+      if (e - s == 1) c.char1[static_cast<unsigned char>(*s)] = code;
       c.i32.push_back(code);
       return true;
     }
@@ -216,15 +229,21 @@ void* tbl_open(const char* path, int ncols, const int32_t* kinds,
   const char delim = delimiter;
   int64_t row = 0;
   while (p < end) {
-    if (*p == '\n') {  // empty line
+    // line end first (SIMD memchr), so field scans are bounded by it and
+    // a malformed short line can never bleed into the next row
+    const char* nl = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (nl == nullptr) nl = end;
+    if (p == nl) {  // empty line
       ++p;
       continue;
     }
     for (int ci = 0; ci < ncols; ++ci) {
-      const char* fs = p;
-      while (p < end && *p != delim && *p != '\n') ++p;
+      const char* fe = static_cast<const char*>(
+          memchr(p, delim, static_cast<size_t>(nl - p)));
+      if (fe == nullptr) fe = nl;
       Column& c = t->cols[static_cast<size_t>(ci)];
-      if (c.kind >= 0 && !parse_field(c, fs, p)) {
+      if (c.kind >= 0 && !parse_field(c, p, fe)) {
         char msg[160];
         snprintf(msg, sizeof msg,
                  "parse error at row %lld col %d (kind %d)",
@@ -233,11 +252,9 @@ void* tbl_open(const char* path, int ncols, const int32_t* kinds,
         munmap(const_cast<char*>(data), size);
         return t;
       }
-      if (p < end && *p == delim) ++p;  // consume field delimiter
+      p = fe < nl ? fe + 1 : nl;  // consume field delimiter
     }
-    // consume trailing delimiter/garbage to end of line
-    while (p < end && *p != '\n') ++p;
-    if (p < end) ++p;
+    p = nl < end ? nl + 1 : end;
     ++row;
   }
   munmap(const_cast<char*>(data), size);
