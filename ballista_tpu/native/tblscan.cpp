@@ -44,6 +44,11 @@ struct Column {
   // per-row string construction + hash lookup; kept consistent with
   // dict_map so mixed-length columns stay correct
   int32_t char1[256];
+  // SQL NULLs: empty non-string fields parse as NULL (CSV convention,
+  // matching the reference's Arrow readers). valid is tracked per row;
+  // has_null lets the wrapper skip materializing all-valid bitmaps.
+  std::vector<uint8_t> valid;
+  bool has_null = false;
   Column() { for (auto& v : char1) v = -1; }
 };
 
@@ -69,8 +74,34 @@ inline int64_t pow10_i(int n) {
   return p;
 }
 
+inline size_t col_size(const Column& c) {
+  switch (c.kind) {
+    case 0: case 2: return c.i64.size();
+    case 1: case 3: case 4: case 6: return c.i32.size();
+    case 5: return c.f32.size();
+  }
+  return 0;
+}
+
 // parse one field [s, e) into column c
 inline bool parse_field(Column& c, const char* s, const char* e) {
+  if (s == e && c.kind >= 0 && c.kind != 4) {
+    // empty non-string field -> SQL NULL (utf8 keeps "" as a value,
+    // the unquoted-format convention). Validity tracking starts lazily
+    // at the first NULL: backfill earlier rows as valid, and the row
+    // loop resizes with 1s after each subsequent parse.
+    if (!c.has_null) {
+      c.valid.assign(col_size(c), 1);
+      c.has_null = true;
+    }
+    switch (c.kind) {
+      case 0: case 2: c.i64.push_back(0); break;
+      case 1: case 3: case 6: c.i32.push_back(0); break;
+      case 5: c.f32.push_back(0.0f); break;
+    }
+    c.valid.push_back(0);
+    return true;
+  }
   switch (c.kind) {
     case 0: case 1: {  // int64 / int32
       bool neg = false;
@@ -243,14 +274,17 @@ void* tbl_open(const char* path, int ncols, const int32_t* kinds,
           memchr(p, delim, static_cast<size_t>(nl - p)));
       if (fe == nullptr) fe = nl;
       Column& c = t->cols[static_cast<size_t>(ci)];
-      if (c.kind >= 0 && !parse_field(c, p, fe)) {
-        char msg[160];
-        snprintf(msg, sizeof msg,
-                 "parse error at row %lld col %d (kind %d)",
-                 static_cast<long long>(row), ci, c.kind);
-        t->error = msg;
-        munmap(const_cast<char*>(data), size);
-        return t;
+      if (c.kind >= 0) {
+        if (!parse_field(c, p, fe)) {
+          char msg[160];
+          snprintf(msg, sizeof msg,
+                   "parse error at row %lld col %d (kind %d)",
+                   static_cast<long long>(row), ci, c.kind);
+          t->error = msg;
+          munmap(const_cast<char*>(data), size);
+          return t;
+        }
+        if (c.has_null) c.valid.resize(col_size(c), 1);
       }
       p = fe < nl ? fe + 1 : nl;  // consume field delimiter
     }

@@ -102,7 +102,8 @@ class HashAggregateExec(PhysicalPlan):
             if not isinstance(a, ex.AggregateExpr):
                 raise ExecutionError(f"not an aggregate expression: {name}")
         self._jit_cache = {}
-        self._ranged_rejected: set = set()
+        self._ranged_rejected = False
+        self._mixed_cache = None
 
     # -- schemas ------------------------------------------------------------
 
@@ -291,57 +292,76 @@ class HashAggregateExec(PhysicalPlan):
             g *= card + (1 if col.validity is not None else 0)
         return g if g > 0 else None
 
-    # Ranged-integer dense grouping: a single plain integer group key
-    # whose live value range fits below these bounds aggregates by O(N)
-    # scatter into a [range] table — no sort, no overflow retry. The
-    # range cap bounds table memory; the capacity factor keeps
+    # Ranged/mixed dense grouping: when every group key is either
+    # dictionary-coded (static cardinality) or integer-valued with a
+    # live range fitting below these bounds, rows aggregate by O(N)
+    # scatter into a mixed-radix [G] table — no sort, no overflow retry.
+    # The range cap bounds table memory; the live-rows factor keeps
     # pathological sparse keys (hash-like ids) on the sort path.
     _RANGED_DENSE_LIMIT = 1 << 23
     _RANGED_CAP_FACTOR = 4
     _RANGED_KINDS = ("int32", "int64", "decimal", "date32", "timestamp_ns")
 
-    def _ranged_key_name(self, batch: ColumnBatch) -> Optional[str]:
-        """Column name when the group key is ONE plain integer-physical
-        column without a dictionary (dictionaries take the dense path
-        on cardinality; expressions would need evaluation first)."""
-        if len(self.group_exprs) != 1:
-            return None
-        e = self.group_exprs[0]
-        if self.mode == "partial":
-            base = ex.strip_alias(e)
-            if not isinstance(base, ex.ColumnRef):
-                return None
-            name = base.column
-        else:
-            name = e.name()
-        try:
-            col = batch.column(name)
-        except Exception:  # noqa: BLE001 - unknown column: not eligible
-            return None
-        if col.dictionary is not None or col.dtype.kind not in self._RANGED_KINDS:
-            return None
-        return name
+    def _mixed_layout(self, batch: ColumnBatch):
+        """Per group key: ("dict", slots) for dictionary/boolean keys or
+        ("int", None) for integer-valued keys (incl. expressions, e.g.
+        EXTRACT(YEAR ...)); None when any key is neither. Classified by
+        TRACING the evaluator (jax.eval_shape — no compute), cached for
+        the operator's lifetime."""
+        if self._mixed_cache is not None:
+            return self._mixed_cache if self._mixed_cache != () else None
+        meta: List = []
 
-    def _key_range_stats(self, batch: ColumnBatch, name: str):
-        """(kmin, kmax, nlive) of the key over live rows, one jitted
-        program, scalars only across the link."""
-        key = ("rstats", name, batch.capacity)
+        def probe(b):
+            kes, _ = self._inputs_and_keys(b)
+            for r in kes:
+                meta.append((r.dtype, r.dictionary))
+            return [r.values for r in kes]
+
+        try:
+            jax.eval_shape(probe, batch)
+        except Exception:  # noqa: BLE001 - untraceable: not eligible
+            self._mixed_cache = ()
+            return None
+        layout = []
+        for dt, d in meta:
+            if d is not None:
+                layout.append(("dict", len(d) + 1))  # +1 NULL/code-0 slot
+            elif dt.kind == "boolean":
+                layout.append(("dict", 3))
+            elif dt.kind in self._RANGED_KINDS:
+                layout.append(("int", None))
+            else:
+                self._mixed_cache = ()
+                return None
+        self._mixed_cache = layout
+        return layout
+
+    def _mixed_stats(self, batch: ColumnBatch, layout):
+        """(per-int-key (min, max) list, nlive): one jitted program,
+        scalars only across the link."""
+        key = ("mstats", batch.capacity)
         if key not in self._jit_cache:
 
             def stats(b):
-                c = b.column(name)
-                v = c.values.astype(jnp.int64)
-                live = b.selection
-                if c.validity is not None:
-                    live = jnp.logical_and(live, c.validity)
+                kes, _ = self._inputs_and_keys(b)
                 maxi = jnp.iinfo(jnp.int64).max
-                return (jnp.min(jnp.where(live, v, maxi)),
-                        jnp.max(jnp.where(live, v, -maxi)),
-                        jnp.sum(live.astype(jnp.int32)))
+                mm = []
+                for (kind, _), r in zip(layout, kes):
+                    if kind != "int":
+                        continue
+                    v = jnp.broadcast_to(r.values, (b.capacity,)) \
+                        .astype(jnp.int64)
+                    live = b.selection
+                    if r.validity is not None:
+                        live = jnp.logical_and(live, r.validity)
+                    mm.append((jnp.min(jnp.where(live, v, maxi)),
+                               jnp.max(jnp.where(live, v, -maxi))))
+                return mm, jnp.sum(b.selection.astype(jnp.int32))
 
             self._jit_cache[key] = jax.jit(stats)
-        kmin, kmax, nlive = jax.device_get(self._jit_cache[key](batch))
-        return int(kmin), int(kmax), int(nlive)
+        mm, nlive = jax.device_get(self._jit_cache[key](batch))
+        return [(int(lo), int(hi)) for lo, hi in mm], int(nlive)
 
     def _exec_grouped(self, batch: ColumnBatch) -> ColumnBatch:
         cap = self.group_capacity
@@ -349,23 +369,37 @@ class HashAggregateExec(PhysicalPlan):
         if bound is not None and bound <= min(DENSE_GROUP_LIMIT, cap):
             out, _ng = self._get_grouped_fn(cap, batch.capacity)(batch)
             return out  # dense path, can't overflow: no sync needed
-        name = self._ranged_key_name(batch)
-        # a column rejected once (hash-like sparse ids) is rejected for
-        # the operator's lifetime: don't pay the stats round-trip again
-        if name is not None and name not in self._ranged_rejected:
-            kmin, kmax, nlive = self._key_range_stats(batch, name)
-            span = kmax - kmin + 2  # +1 slot for NULL keys at gid 0
-            # admission gates on LIVE rows (not capacity): sparse
-            # post-filter batches must not allocate huge group tables
-            if 0 < span - 1 and span <= min(self._RANGED_DENSE_LIMIT,
-                                            self._RANGED_CAP_FACTOR
-                                            * (nlive + 256)):
-                G = round_capacity(span)
-                fn = self._get_ranged_fn(G, batch.capacity, name)
-                out, _ng = fn(batch, jnp.int64(kmin))
-                return out  # gid < G by construction: no overflow sync
-            if span - 1 > 0:  # a real range that failed the bound
-                self._ranged_rejected.add(name)
+        # rejected once (hash-like sparse ids / huge products) -> rejected
+        # for the operator's lifetime: don't pay the stats round-trip again
+        layout = None if self._ranged_rejected else self._mixed_layout(batch)
+        if layout is not None:
+            mm, nlive = self._mixed_stats(batch, layout)
+            if any(lo > hi for lo, hi in mm):
+                pass  # no live rows: sort path handles the empty batch
+            else:
+                spans, bases = [], []
+                it = iter(mm)
+                for kind, slots in layout:
+                    if kind == "dict":
+                        spans.append(slots)
+                    else:
+                        lo, hi = next(it)
+                        # +1 NULL slot; quantized so successive batches
+                        # with similar ranges reuse one compiled program
+                        spans.append(round_capacity(hi - lo + 2))
+                        bases.append(lo)
+                g_total = 1
+                for s in spans:
+                    g_total *= s
+                # admission gates on LIVE rows (not capacity): sparse
+                # post-filter batches must not allocate huge group tables
+                if g_total <= min(self._RANGED_DENSE_LIMIT,
+                                  self._RANGED_CAP_FACTOR * (nlive + 256)):
+                    fn = self._get_mixed_fn(tuple(spans), batch.capacity,
+                                            layout)
+                    out, _ng = fn(batch, jnp.asarray(bases, jnp.int64))
+                    return out  # gid < G by construction: no overflow sync
+                self._ranged_rejected = True
         while True:
             fn = self._get_grouped_fn(cap, batch.capacity)
             out, num_groups = fn(batch)
@@ -427,24 +461,39 @@ class HashAggregateExec(PhysicalPlan):
             self._jit_cache[key] = jax.jit(run)
         return self._jit_cache[key]
 
-    def _get_ranged_fn(self, G: int, in_cap: int, name: str):
-        """Grouping program for ONE integer key whose live values fit in
-        [base, base+G): gid = key - base + 1 (slot 0 = NULL keys), O(N)
-        scatter aggregation, no sort and no overflow. ``base`` is a
-        traced argument so consecutive batches with different ranges but
-        the same quantized span reuse one compiled program."""
-        key = ("ranged", self.mode, G, in_cap, name)
+    def _get_mixed_fn(self, spans, in_cap: int, layout):
+        """Grouping program for mixed dict/ranged-int keys: mixed-radix
+        gid over per-key slots (slot 0 of each radix = NULL), O(N)
+        scatter aggregation, no sort and no overflow. Integer-key bases
+        are a traced argument so consecutive batches with different
+        ranges but the same quantized spans reuse one compiled
+        program."""
+        key = ("mixed", self.mode, spans, in_cap)
         if key not in self._jit_cache:
+            g_total = 1
+            for s in spans:
+                g_total *= s
+            # pad the table so the output batch capacity is a power of
+            # two (downstream jit caches key on capacity); gids stay
+            # below the exact strides product
+            G = round_capacity(g_total)
 
-            def run(batch: ColumnBatch, base):
+            def run(batch: ColumnBatch, bases):
                 key_evals, aggs = self._inputs_and_keys(batch)
-                r = key_evals[0]
-                k = jnp.broadcast_to(r.values, (batch.capacity,)) \
-                    .astype(jnp.int64)
-                gid = (k - base + 1).astype(jnp.int32)
-                if r.validity is not None:
-                    gid = jnp.where(r.validity, gid, 0)
-                res = dense_grouped_scatter(gid, batch.selection, aggs, G)
+                gid = jnp.zeros((batch.capacity,), jnp.int64)
+                bi = 0
+                for (kind, _), span, r in zip(layout, spans, key_evals):
+                    v = jnp.broadcast_to(r.values, (batch.capacity,))
+                    if kind == "dict":
+                        c = v.astype(jnp.int64) + 1
+                    else:
+                        c = v.astype(jnp.int64) - bases[bi] + 1
+                        bi += 1
+                    if r.validity is not None:
+                        c = jnp.where(r.validity, c, 0)
+                    gid = gid * span + c
+                res = dense_grouped_scatter(gid.astype(jnp.int32),
+                                            batch.selection, aggs, G)
                 return self._assemble(batch, key_evals, res, G), \
                     res.num_groups
 
