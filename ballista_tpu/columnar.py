@@ -259,9 +259,13 @@ class ColumnBatch:
         arrays: Dict[str, np.ndarray],
         dictionaries: Optional[Dict[str, Dictionary]] = None,
         capacity: Optional[int] = None,
+        validity: Optional[Dict[str, np.ndarray]] = None,
     ) -> "ColumnBatch":
-        """Build a batch from host arrays of physical values, padding to capacity."""
+        """Build a batch from host arrays of physical values, padding to
+        capacity. ``validity`` maps column name -> bool array of length n
+        (True = valid); columns absent from it are all-valid."""
         dictionaries = dictionaries or {}
+        validity = validity or {}
         n = None
         for name, arr in arrays.items():
             if n is None:
@@ -283,8 +287,16 @@ class ColumnBatch:
             if n < cap:
                 pad = np.zeros(cap - n, dtype=want)
                 arr = np.concatenate([arr, pad])
+            va = validity.get(f.name)
+            if va is not None:
+                va = np.asarray(va, dtype=np.bool_)
+                if len(va) < cap:  # padding rows are not valid
+                    va = np.concatenate(
+                        [va, np.zeros(cap - len(va), dtype=np.bool_)]
+                    )
+                va = _upload(va, np.bool_)
             cols.append(
-                Column(_upload(arr, want), f.dtype, None,
+                Column(_upload(arr, want), f.dtype, va,
                        dictionaries.get(f.name))
             )
         sel = np.zeros(cap, dtype=np.bool_)

@@ -109,6 +109,12 @@ def _load():
             ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.tbl_has_null.restype = ctypes.c_int
+        lib.tbl_has_null.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tbl_fill_valid.restype = ctypes.c_int
+        lib.tbl_fill_valid.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
+        ]
         lib.tbl_close.restype = None
         lib.tbl_close.argtypes = [ctypes.c_void_p]
         _lib = lib
@@ -125,9 +131,12 @@ def scan_file(
     wanted: Sequence[str],
     delimiter: str = "|",
     skip_header: bool = False,
-) -> Tuple[int, Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+) -> Tuple[int, Dict[str, np.ndarray], Dict[str, np.ndarray],
+           Dict[str, np.ndarray]]:
     """Parse one file natively. Returns (num_rows, physical arrays,
-    raw dictionary values per utf8 column — sorted, codes ordinal)."""
+    raw dictionary values per utf8 column — sorted, codes ordinal,
+    validity bool arrays for columns that saw SQL NULLs — empty
+    non-string fields; all-valid columns are absent from the dict)."""
     lib = _load()
     if lib is None:
         raise IoError("native scanner not built")
@@ -148,6 +157,7 @@ def scan_file(
         n = lib.tbl_num_rows(h)
         arrays: Dict[str, np.ndarray] = {}
         dicts: Dict[str, np.ndarray] = {}
+        valids: Dict[str, np.ndarray] = {}
         for name in wanted:
             i = schema.index_of(name)
             f = schema.fields[i]
@@ -189,6 +199,13 @@ def scan_file(
                 ):
                     raise IoError(f"column {name}: fill failed")
                 arrays[name] = buf
-        return int(n), arrays, dicts
+            if n and lib.tbl_has_null(h, i):
+                vbuf = np.empty(n, dtype=np.uint8)
+                if lib.tbl_fill_valid(
+                    h, i, vbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+                ):
+                    raise IoError(f"column {name}: validity fill failed")
+                valids[name] = vbuf.astype(np.bool_)
+        return int(n), arrays, dicts, valids
     finally:
         lib.tbl_close(h)

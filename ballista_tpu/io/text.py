@@ -99,6 +99,15 @@ class DelimitedSource(TableSource):
     def _read_pandas(self, path: str, names: List[str], usecols: List[int]):
         import pandas as pd
 
+        # integer columns parse as nullable Int64: exact above 2^53 AND
+        # NA-capable (a bare float64 parse would silently round large
+        # ints the moment any row has an empty field)
+        dtype = {}
+        for i in usecols:
+            if i < len(self._schema):
+                f = self._schema.fields[i]
+                if f.dtype.kind in ("int64", "int32"):
+                    dtype[f.name] = "Int64"
         return pd.read_csv(
             path,
             sep=self._delim,
@@ -107,6 +116,7 @@ class DelimitedSource(TableSource):
             usecols=usecols,
             engine="c",
             skipinitialspace=False,
+            dtype=dtype or None,
         )
 
     def _column_names(self) -> List[str]:
@@ -124,14 +134,18 @@ class DelimitedSource(TableSource):
         uniq: Optional[np.ndarray] = None
         for f in self._files:
             if self._use_native():
-                _, _, fd = native.scan_file(
+                _, _, fd, _ = native.scan_file(
                     f, self._schema, [colname], self._delim, self._header
                 )
                 u = fd[colname]
             else:
                 idx = self._schema.index_of(colname)
                 df = self._read_pandas(f, self._column_names(), [idx])
-                u = np.unique(df[colname].astype(str).to_numpy(dtype=object))
+                # empty fields: "" is a utf8 VALUE (native-scanner
+                # convention), not NULL
+                u = np.unique(
+                    df[colname].fillna("").astype(str).to_numpy(dtype=object)
+                )
             uniq = u if uniq is None else np.unique(np.concatenate([uniq, u]))
         d = Dictionary(uniq if uniq is not None else [])
         self._dicts[colname] = d
@@ -151,11 +165,11 @@ class DelimitedSource(TableSource):
         names = projection if projection is not None else self._schema.names()
         sub_schema = self._schema.project(names)
         if self._use_native():
-            n, arrays, dicts = self._scan_native(partition, names)
+            n, arrays, dicts, valids = self._scan_native(partition, names)
         else:
-            n, arrays, dicts = self._scan_pandas(partition, names)
+            n, arrays, dicts, valids = self._scan_pandas(partition, names)
         # chunk into fixed-capacity batches
-        yield from self._emit_batches(sub_schema, n, arrays, dicts)
+        yield from self._emit_batches(sub_schema, n, arrays, dicts, valids)
 
     def _scan_native(self, partition: int, names):
         """Native C++ scan; per-file utf8 dictionaries are remapped onto the
@@ -163,7 +177,7 @@ class DelimitedSource(TableSource):
         partitions. Single-file tables adopt the file dictionary directly."""
         from . import native
 
-        n, arrays, fdicts = native.scan_file(
+        n, arrays, fdicts, valids = native.scan_file(
             self._files[partition], self._schema, list(names),
             self._delim, self._header,
         )
@@ -190,7 +204,7 @@ class DelimitedSource(TableSource):
                 remap = np.searchsorted(d.values.astype(str), fvals.astype(str))
                 arrays[name] = remap[arrays[name]].astype(np.int32)
             dicts[name] = d
-        return n, arrays, dicts
+        return n, arrays, dicts, valids
 
     def _scan_pandas(self, partition: int, names):
         idxs = [self._schema.index_of(n) for n in names]
@@ -198,12 +212,22 @@ class DelimitedSource(TableSource):
         n = len(df)
         arrays: Dict[str, np.ndarray] = {}
         dicts: Dict[str, Dictionary] = {}
+        valids: Dict[str, np.ndarray] = {}
         for name in names:
             field = self._schema.field(name)
             raw = df[name]  # pandas labels used columns by the given names
+            # empty non-string fields are SQL NULLs (same convention as
+            # the native scanner); "" stays a utf8 VALUE
+            na = raw.isna().to_numpy() if field.dtype.kind != "utf8" else None
+            if na is not None and na.any():
+                valids[name] = ~na
+                fill = ("1970-01-01"
+                        if field.dtype.kind in ("date32", "timestamp_ns")
+                        else 0)
+                raw = raw.fillna(fill)
             if field.dtype.kind == "utf8":
                 d = self._dictionary_for(name)
-                vals = raw.astype(str).to_numpy(dtype=object)
+                vals = raw.fillna("").astype(str).to_numpy(dtype=object)
                 codes = np.searchsorted(d.values.astype(str), vals.astype(str))
                 arrays[name] = codes.astype(np.int32)
                 dicts[name] = d
@@ -221,16 +245,21 @@ class DelimitedSource(TableSource):
                 arrays[name] = vals.astype(np.int64)
             else:
                 arrays[name] = raw.to_numpy(dtype=field.dtype.device_dtype())
-        return n, arrays, dicts
+        return n, arrays, dicts, valids
 
-    def _emit_batches(self, sub_schema, n, arrays, dicts):
+    def _emit_batches(self, sub_schema, n, arrays, dicts, valids=None):
         cap = min(self._capacity, round_capacity(max(n, 1)))
         start = 0
         emitted = False
         while start < n or not emitted:
             end = min(start + cap, n)
             chunk = {k: v[start:end] for k, v in arrays.items()}
-            yield ColumnBatch.from_numpy(sub_schema, chunk, dicts, capacity=cap)
+            vchunk = (
+                {k: v[start:end] for k, v in valids.items()}
+                if valids else None
+            )
+            yield ColumnBatch.from_numpy(sub_schema, chunk, dicts,
+                                         capacity=cap, validity=vchunk)
             emitted = True
             start = end
             if start >= n:

@@ -355,6 +355,23 @@ int tbl_fill_dict(void* h, int col, char* out, int64_t* offsets) {
   return 0;
 }
 
+// 1 when the column saw at least one NULL (empty field); 0 = all valid
+// (the wrapper can then skip materializing a bitmap entirely)
+int tbl_has_null(void* h, int col) {
+  return static_cast<Table*>(h)->cols[static_cast<size_t>(col)].has_null ? 1 : 0;
+}
+
+// fill per-row validity bytes (1 = valid, 0 = NULL); num_rows entries.
+// Only meaningful when tbl_has_null returns 1.
+int tbl_fill_valid(void* h, int col, uint8_t* out) {
+  auto* t = static_cast<Table*>(h);
+  auto& c = t->cols[static_cast<size_t>(col)];
+  if (!c.has_null) return -1;
+  if (static_cast<int64_t>(c.valid.size()) != t->num_rows) return -1;
+  memcpy(out, c.valid.data(), c.valid.size());
+  return 0;
+}
+
 void tbl_close(void* h) { delete static_cast<Table*>(h); }
 
 }  // extern "C"

@@ -104,6 +104,7 @@ class HashAggregateExec(PhysicalPlan):
         self._jit_cache = {}
         self._ranged_rejected = False
         self._mixed_cache = None
+        self._mixed_fingerprint = None
 
     # -- schemas ------------------------------------------------------------
 
@@ -306,10 +307,21 @@ class HashAggregateExec(PhysicalPlan):
         """Per group key: ("dict", slots) for dictionary/boolean keys or
         ("int", None) for integer-valued keys (incl. expressions, e.g.
         EXTRACT(YEAR ...)); None when any key is neither. Classified by
-        TRACING the evaluator (jax.eval_shape — no compute), cached for
-        the operator's lifetime."""
-        if self._mixed_cache is not None:
-            return self._mixed_cache if self._mixed_cache != () else None
+        TRACING the evaluator (jax.eval_shape — no compute). Kind
+        classification is stable for the operator's lifetime, but dict
+        SPANS are not: different partitions' batches carry different
+        dictionaries, and a span cached from a smaller dictionary would
+        overflow its mixed-radix digit and collide groups. The cache is
+        therefore keyed on the batch's dictionary lengths and re-probed
+        when they change."""
+        if self._mixed_cache == ():  # dtype kinds never change: permanent
+            return None
+        fp = tuple(
+            len(c.dictionary) if c.dictionary is not None else -1
+            for c in batch.columns
+        )
+        if self._mixed_cache is not None and self._mixed_fingerprint == fp:
+            return self._mixed_cache
         meta: List = []
 
         def probe(b):
@@ -335,6 +347,7 @@ class HashAggregateExec(PhysicalPlan):
                 self._mixed_cache = ()
                 return None
         self._mixed_cache = layout
+        self._mixed_fingerprint = fp
         return layout
 
     def _mixed_stats(self, batch: ColumnBatch, layout):

@@ -85,8 +85,11 @@ def test_fused_aggregation_across_process_mesh(tmp_path):
         from ballista_tpu.client import BallistaContext
         from ballista_tpu.io import TblSource
 
+        # claim the mesh width so planning waits for the leader's first
+        # poll instead of racing it (unclaimed + unregistered -> unfused)
         ctx = BallistaContext.remote("localhost", int(sport),
-                                     **{"agg.partitions": "8"})
+                                     **{"agg.partitions": "8",
+                                        "mesh.devices": "8"})
         ctx.register_source(
             "t", TblSource(str(d), schema(("k", Utf8), ("v", Int64))))
         got = ctx.sql(
@@ -128,7 +131,7 @@ def test_fused_aggregation_across_process_mesh(tmp_path):
         ctx2 = BallistaContext.remote(
             "localhost", int(sport),
             **{"join.partitioned.threshold": "1", "join.partitions": "8",
-               "agg.partitions": "8"},
+               "agg.partitions": "8", "mesh.devices": "8"},
         )
         ctx2.register_source(
             "dim", TblSource(str(dim), schema(("dkey", Int64),

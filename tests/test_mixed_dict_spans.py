@@ -1,0 +1,66 @@
+"""Regression: the mixed-radix dense grouping path must re-derive
+dictionary spans per batch. One HashAggregateExec instance executes many
+partitions, and each partition's batches can carry a DIFFERENT
+dictionary (per-file scans intern per-file string tables); a span cached
+from a smaller first-batch dictionary would overflow the mixed-radix
+digit of later batches' codes and silently collide groups (round-3
+advisor finding, ballista_tpu/physical/aggregate.py)."""
+
+import numpy as np
+import pandas as pd
+
+from ballista_tpu import schema, col, sum_, Int64, Utf8
+from ballista_tpu.io import MemTableSource
+from ballista_tpu.physical.aggregate import HashAggregateExec
+from ballista_tpu.physical.operators import ScanExec
+
+
+def _part_dict(src):
+    """First batch of a single-partition source."""
+    return next(src.scan(0))
+
+
+def test_mixed_dict_span_grows_across_partitions():
+    s = schema(("k", Utf8), ("g", Int64), ("v", Int64))
+    rng = np.random.default_rng(7)
+
+    # partition 0: tiny dictionary (2 distinct strings)
+    n0 = 300
+    d0 = {
+        "k": [["a", "b"][i % 2] for i in range(n0)],
+        "g": rng.integers(0, 10, n0),
+        "v": rng.integers(0, 100, n0),
+    }
+    # partition 1: much larger dictionary -> codes exceed partition 0's
+    # span; the buggy cached span corrupts these groups
+    n1 = 400
+    d1 = {
+        "k": [f"x{i % 37}" for i in range(n1)],
+        "g": rng.integers(0, 10, n1),
+        "v": rng.integers(0, 100, n1),
+    }
+    b0 = _part_dict(MemTableSource.from_pydict(s, d0))
+    b1 = _part_dict(MemTableSource.from_pydict(s, d1))
+    assert b0.column("k").dictionary is not None
+    assert len(b1.column("k").dictionary) > len(b0.column("k").dictionary)
+
+    src = MemTableSource(s, [[b0], [b1]])
+    op = HashAggregateExec(
+        "partial", [col("k"), col("g")],
+        [sum_(col("v")).alias("sv")], ScanExec("t", src),
+    )
+
+    for part, data in ((0, d0), (1, d1)):
+        outs = list(op.execute(part))
+        got = pd.concat([b.to_pandas() for b in outs], ignore_index=True)
+        sum_col = [c for c in got.columns if c.endswith("sum")][0]
+        got = (got.groupby(["k", "g"])[sum_col].sum().reset_index()
+               .sort_values(["k", "g"]).reset_index(drop=True))
+        exp = (pd.DataFrame(data).groupby(["k", "g"])["v"].sum()
+               .reset_index().sort_values(["k", "g"])
+               .reset_index(drop=True))
+        np.testing.assert_array_equal(got["k"], exp["k"])
+        np.testing.assert_array_equal(
+            got["g"].astype(np.int64), exp["g"].astype(np.int64))
+        np.testing.assert_array_equal(
+            got[sum_col].astype(np.int64), exp["v"].astype(np.int64))
