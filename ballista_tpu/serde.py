@@ -290,6 +290,9 @@ def plan_to_proto(plan: lp.LogicalPlan) -> pb.LogicalPlanNode:
             n.repartition.hash_exprs.append(expr_to_proto(e))
     elif isinstance(plan, lp.EmptyRelation):
         n.empty.produce_one_row = plan.produce_one_row
+    elif isinstance(plan, lp.Explain):
+        n.explain.input.CopyFrom(plan_to_proto(plan.input))
+        n.explain.verbose = plan.verbose
     else:
         raise SerdeError(f"cannot serialize plan {type(plan).__name__}")
     return n
@@ -340,6 +343,8 @@ def plan_from_proto(n: pb.LogicalPlanNode) -> lp.LogicalPlan:
         )
     if kind == "empty":
         return lp.EmptyRelation(n.empty.produce_one_row)
+    if kind == "explain":
+        return lp.Explain(plan_from_proto(n.explain.input), n.explain.verbose)
     raise SerdeError(f"unknown plan node {kind}")
 
 

@@ -15,6 +15,7 @@ KEYWORDS = {
     "anti", "on", "date", "interval", "extract", "union", "all", "exists",
     "create", "external", "table", "stored", "location", "with", "header",
     "row", "nulls", "first", "last", "true", "false", "offset", "using",
+    "explain", "verbose",
 }
 
 TWO_CHAR_OPS = ("<=", ">=", "<>", "!=", "||")

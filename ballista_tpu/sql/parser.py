@@ -61,6 +61,14 @@ class Query:
 
 
 @dataclass
+class ExplainStmt:
+    """EXPLAIN [VERBOSE] <select> (reference: rust/core/proto/
+    ballista.proto:232 ExplainNode; DataFusion's SQL EXPLAIN surface)."""
+    query: "Query"
+    verbose: bool = False
+
+
+@dataclass
 class CreateExternalTable:
     name: str
     columns: List[Tuple[str, str]]  # (name, type string)
@@ -134,13 +142,25 @@ class Parser:
     def parse_statement(self) -> Statement:
         if self.peek().is_kw("create"):
             return self.parse_create_external_table()
+        if self.peek().is_kw("explain"):
+            self.next()
+            verbose = self.accept_kw("verbose") is not None
+            if not self.peek().is_kw("select"):
+                raise SqlError(
+                    f"EXPLAIN expects SELECT, got {self.peek().value!r}")
+            q = self.parse_query()
+            self.accept_op(";")
+            if self.peek().kind != "eof":
+                raise SqlError(f"trailing tokens at {self.peek().pos}")
+            return ExplainStmt(q, verbose)
         if self.peek().is_kw("select"):
             q = self.parse_query()
             self.accept_op(";")
             if self.peek().kind != "eof":
                 raise SqlError(f"trailing tokens at {self.peek().pos}")
             return q
-        raise SqlError(f"expected SELECT or CREATE, got {self.peek().value!r}")
+        raise SqlError(
+            f"expected SELECT, EXPLAIN or CREATE, got {self.peek().value!r}")
 
     def parse_create_external_table(self) -> CreateExternalTable:
         self.expect_kw("create")
