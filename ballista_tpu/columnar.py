@@ -285,7 +285,9 @@ class ColumnBatch:
             if arr.dtype != want:
                 arr = arr.astype(want)
             if n < cap:
-                pad = np.zeros(cap - n, dtype=want)
+                # trailing dims (fixed-size-list element axis) pad along
+                # the row axis only
+                pad = np.zeros((cap - n,) + arr.shape[1:], dtype=want)
                 arr = np.concatenate([arr, pad])
             va = validity.get(f.name)
             if va is not None:
@@ -368,6 +370,12 @@ class ColumnBatch:
             invalid = None
             if va is not None:
                 invalid = ~np.asarray(va)[mask]
+            if f.dtype.kind == "list":
+                out[f.name] = decode_list_rows(
+                    np.asarray(v)[mask], f.dtype.element.kind,
+                    f.dtype.element.scale, invalid,
+                )
+                continue
             out[f.name] = decode_physical_array(
                 np.asarray(v)[mask], f.dtype.kind, f.dtype.scale,
                 col.dictionary.values if col.dictionary is not None else None,
@@ -483,6 +491,26 @@ def decode_physical_array(
     if has_nulls:
         out[null_mask] = np.nan
     return out
+
+
+def decode_list_rows(
+    vals2d: np.ndarray,
+    element_kind: str,
+    element_scale: int,
+    null_mask: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """(rows, length) physical list values -> object array of per-row 1-D
+    logical vectors (None for NULL rows). Shared by local collect and the
+    distributed result-fetch path, like ``decode_physical_array``."""
+    arr = np.asarray(vals2d)
+    flat = decode_physical_array(arr.reshape(-1), element_kind,
+                                 element_scale, None, None)
+    rows = np.asarray(flat).reshape(arr.shape)
+    cell = np.empty(arr.shape[0], dtype=object)
+    for i in range(arr.shape[0]):
+        cell[i] = (None if null_mask is not None and null_mask[i]
+                   else rows[i])
+    return cell
 
 
 def empty_batch(schema) -> "ColumnBatch":

@@ -39,12 +39,19 @@ class DataType:
     kind: str
     # Decimal only: digits after the point. Physical value = logical * 10**scale.
     scale: int = 0
+    # FixedSizeList only: element type + fixed per-row length. Physical
+    # representation is a (capacity, length) device array of the element's
+    # physical dtype (SoA stays rectangular — no ragged buffers on TPU).
+    element: Optional["DataType"] = None
+    length: int = 0
 
     # -- constructors -------------------------------------------------------
 
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         if self.kind == "decimal":
             return f"Decimal(scale={self.scale})"
+        if self.kind == "list":
+            return f"FixedSizeList({self.element!r}, {self.length})"
         return self.kind.capitalize()
 
     # -- classification -----------------------------------------------------
@@ -84,6 +91,8 @@ class DataType:
             "timestamp_ns": np.int64,  # epoch nanoseconds
             "utf8": np.int32,  # dictionary codes
         }
+        if self.kind == "list":
+            return self.element.device_dtype()
         if self.kind not in m:
             raise SchemaError(f"no device representation for {self.kind}")
         return np.dtype(m[self.kind])
@@ -103,6 +112,15 @@ TimestampNs = DataType("timestamp_ns")
 
 def Decimal(scale: int = 2) -> DataType:
     return DataType("decimal", scale=scale)
+
+
+def FixedSizeList(element: DataType, length: int) -> DataType:
+    """ARRAY constructor result type (reference surface:
+    rust/core/proto/ballista.proto:105 ARRAY -> DataFusion fixed-size
+    list). Rectangular (capacity, length) physical layout."""
+    if element.kind == "list":
+        raise SchemaError("nested lists are not supported")
+    return DataType("list", element=element, length=length)
 
 
 _BY_NAME = {

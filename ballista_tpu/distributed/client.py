@@ -10,6 +10,8 @@ import os
 import time
 from typing import Dict, Optional
 
+import numpy as np
+
 from ..errors import ClusterError, PlanError
 from ..proto import ballista_pb2 as pb
 from .. import serde
@@ -161,6 +163,13 @@ def _fetch_result_frames(result: pb.GetJobStatusResult):
             kind, scale = kinds.get(name, ("", 0))
             from ..columnar import decode_physical_array
 
+            if kind.startswith("list:"):
+                from ..columnar import decode_list_rows
+
+                cols[name] = decode_list_rows(
+                    arrays[name], kind.split(":", 1)[1], scale, nulls[name]
+                )
+                continue
             cols[name] = decode_physical_array(
                 arrays[name],
                 "utf8" if name in dicts else kind,

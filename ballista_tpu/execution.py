@@ -100,6 +100,19 @@ def resolve_scalar_subqueries(plan: LogicalPlan, options=None) -> LogicalPlan:
 
 
 def plan_logical(plan: LogicalPlan, options=None) -> PhysicalPlan:
+    from .logical import Explain
+
+    if isinstance(plan, Explain):
+        # render before AND after optimization so EXPLAIN VERBOSE can show
+        # what the optimizer did; the rows execute as a normal leaf node
+        # (distributed: the text rides the standard shuffle/fetch path)
+        from .physical.explain import render_explain
+
+        inner = resolve_scalar_subqueries(plan.input, options)
+        unopt = inner.pretty()
+        opt = optimize(inner)
+        return render_explain(opt, create_physical_plan(opt, options),
+                              plan.verbose, unoptimized_text=unopt)
     plan = resolve_scalar_subqueries(plan, options)
     return create_physical_plan(optimize(plan), options)
 

@@ -542,6 +542,9 @@ SCALAR_FUNCTIONS = {
     "extract_day": (1, "int"),
     "nullif": (2, "same"),
     "coalesce": (-1, "same"),
+    # ARRAY constructor (reference: rust/core/proto/ballista.proto:105) —
+    # numeric/temporal elements, coerced to a common type
+    "array": (-1, "array"),
 }
 
 
@@ -579,6 +582,19 @@ class ScalarFunction(Expr):
             from .datatypes import TimestampNs
 
             return Field(self.name(), TimestampNs, nullable)
+        if rule == "array":
+            from .datatypes import FixedSizeList
+
+            if not self.args:
+                raise PlanError("array() requires at least one argument")
+            dts = [a.to_field(schema).dtype for a in self.args]
+            if any(d.kind in ("utf8", "list") for d in dts):
+                raise PlanError("array() supports numeric/temporal elements")
+            elem = dts[0]
+            for d in dts[1:]:
+                elem = d if d == elem else common_numeric_type(elem, d)
+            return Field(self.name(), FixedSizeList(elem, len(self.args)),
+                         nullable)
         raise PlanError(f"bad rule for {self.fn}")
 
 

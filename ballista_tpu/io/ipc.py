@@ -57,6 +57,17 @@ def batch_to_arrow(batch: ColumnBatch):
             )
             arr = pa.DictionaryArray.from_arrays(codes, dict_vals)
             fields.append(pa.field(f.name, arr.type, True, meta))
+        elif f.dtype.kind == "list":
+            # fixed-size list: (rows, length) physical array -> real Arrow
+            # FixedSizeListArray (element kind/scale ride in metadata so
+            # decimal elements decode without Arrow decimal types)
+            meta[b"ballista.element_kind"] = f.dtype.element.kind.encode()
+            meta[b"ballista.element_scale"] = str(
+                f.dtype.element.scale).encode()
+            flat = pa.array(vals.reshape(-1))
+            arr = pa.FixedSizeListArray.from_arrays(
+                flat, f.dtype.length, mask=nulls)
+            fields.append(pa.field(f.name, arr.type, True, meta))
         else:
             arr = pa.array(vals, mask=nulls)
             fields.append(pa.field(f.name, arr.type, True, meta))
@@ -191,6 +202,17 @@ def read_partition_arrays(
             dicts[name] = np.asarray(chunk.dictionary.to_pylist(), dtype=object)
             arrays[name] = np.where(null_mask, 0, codes).astype(np.int32)
             kinds[name] = ("utf8", 0)
+        elif pa.types.is_fixed_size_list(chunk.type):
+            null_mask = np.asarray(chunk.is_null())
+            width = chunk.type.list_size
+            # .values spans all slots (incl. null rows), so the reshape
+            # stays aligned with the row axis
+            flat = chunk.values.to_numpy(zero_copy_only=False)
+            arrays[name] = flat.reshape(len(chunk), width)
+            ekind = (meta.get(b"ballista.element_kind", b"").decode()
+                     or str(chunk.type.value_type))
+            escale = int(meta.get(b"ballista.element_scale", b"0") or 0)
+            kinds[name] = (f"list:{ekind}", escale)
         else:
             null_mask = np.asarray(chunk.is_null())
             if pa.types.is_integer(chunk.type):
@@ -255,8 +277,11 @@ def batches_from_parts(
                 vals = remaps[f.name][pi]
             else:
                 vals = arrays[f.name].astype(f.dtype.device_dtype())
-            pad = np.zeros(cap - n, dtype=f.dtype.device_dtype())
-            vals = np.concatenate([vals.astype(f.dtype.device_dtype()), pad])
+            vals = vals.astype(f.dtype.device_dtype())
+            # pad along the row axis only (list columns are 2-D)
+            pad = np.zeros((cap - n,) + vals.shape[1:],
+                           dtype=f.dtype.device_dtype())
+            vals = np.concatenate([vals, pad])
             nm = nulls.get(f.name)
             validity = None
             if nm is not None and nm.any():

@@ -105,7 +105,10 @@ class Evaluator:
 
     def to_column(self, e: ex.Expr, batch: ColumnBatch) -> Column:
         r = self.evaluate(e, batch)
-        vals = jnp.broadcast_to(r.values, (batch.capacity,))
+        # scalar/1-D values broadcast to (capacity,); fixed-size-list
+        # values keep their trailing element axis: (capacity, length)
+        trailing = tuple(getattr(r.values, "shape", ()))[1:]
+        vals = jnp.broadcast_to(r.values, (batch.capacity,) + trailing)
         return Column(vals, r.dtype, r.validity, r.dictionary)
 
     # ----------------------------------------------------------- leaf nodes
@@ -514,6 +517,16 @@ class Evaluator:
             return self._eval_date_fn(e, batch)
         args = [self.evaluate(a, batch) for a in e.args]
         validity = _and_validity(*[a.validity for a in args])
+        if fn == "array":
+            # rectangular (capacity, n) stack; a NULL element NULLs the row
+            # (documented restriction — no per-element validity planes)
+            out_f = e.to_field(batch.schema)
+            elem = out_f.dtype.element
+            cap = batch.capacity
+            norm = [self._cast(a, elem) for a in args]
+            stacked = jnp.stack(
+                [jnp.broadcast_to(a.values, (cap,)) for a in norm], axis=1)
+            return Evaluated(stacked, out_f.dtype, validity)
         if fn == "nullif":
             eqr = self._compare("=", args[0], args[1], None)
             base_valid = args[0].valid_or(batch.capacity)

@@ -253,6 +253,18 @@ class Join(LogicalPlan):
 # Explain
 # ---------------------------------------------------------------------------
 
+# (plan_type, plan) rows, matching the surface the reference's users see
+# through DataFusion's EXPLAIN output table. Single source of truth: the
+# physical ExplainExec imports this.
+def _explain_schema() -> Schema:
+    from .datatypes import Utf8
+
+    return Schema([Field("plan_type", Utf8, False),
+                   Field("plan", Utf8, False)])
+
+
+EXPLAIN_SCHEMA = _explain_schema()
+
 
 @dataclass
 class Explain(LogicalPlan):
@@ -260,15 +272,13 @@ class Explain(LogicalPlan):
     verbose: bool = False
 
     def schema(self) -> Schema:
-        from .datatypes import Utf8
-
-        return Schema([Field("plan", Utf8, False)])
+        return EXPLAIN_SCHEMA
 
     def children(self) -> List[LogicalPlan]:
         return [self.input]
 
     def display(self) -> str:
-        return "Explain"
+        return "Explain" + (" verbose" if self.verbose else "")
 
 
 # ---------------------------------------------------------------------------

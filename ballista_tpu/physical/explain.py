@@ -1,0 +1,69 @@
+"""EXPLAIN execution: a leaf operator that yields pre-rendered plan text.
+
+The reference serializes DataFusion's EXPLAIN through ExplainNode
+(reference: rust/core/proto/ballista.proto:232); here the scheduler/client
+renders the plan during physical planning and the result rows travel like
+any other single-partition result (so distributed EXPLAIN needs no special
+result channel — the text rides the normal shuffle/fetch path).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+from ..columnar import ColumnBatch
+from ..datatypes import Schema
+from ..logical import EXPLAIN_SCHEMA
+from .base import Partitioning, PhysicalPlan
+
+
+class ExplainExec(PhysicalPlan):
+    """Leaf node holding rendered ``(plan_type, plan)`` rows."""
+
+    def __init__(self, rows: List[Tuple[str, str]]):
+        self.rows = [(str(t), str(p)) for t, p in rows]
+
+    def output_schema(self) -> Schema:
+        return EXPLAIN_SCHEMA
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning("unknown", 1)
+
+    def children(self) -> List[PhysicalPlan]:
+        return []
+
+    def with_new_children(self, children) -> "ExplainExec":
+        return self
+
+    def estimated_rows(self):
+        return len(self.rows)
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        from ..io.memory import MemTableSource
+
+        src = MemTableSource.from_pydict(
+            EXPLAIN_SCHEMA,
+            {"plan_type": [t for t, _ in self.rows],
+             "plan": [p for _, p in self.rows]},
+        )
+        yield from src.scan(0)
+
+    def display(self) -> str:
+        return f"ExplainExec rows={len(self.rows)}"
+
+
+def render_explain(logical_input, physical_input: PhysicalPlan,
+                   verbose: bool,
+                   unoptimized_text: str | None = None) -> ExplainExec:
+    """Build the EXPLAIN result rows from planned inputs.
+
+    Non-verbose mirrors the two-row (logical_plan, physical_plan) surface;
+    verbose additionally shows the pre-optimization logical plan when the
+    caller captured one.
+    """
+    rows: List[Tuple[str, str]] = []
+    if verbose and unoptimized_text is not None:
+        rows.append(("initial_logical_plan", unoptimized_text))
+    rows.append(("logical_plan", logical_input.pretty()))
+    rows.append(("physical_plan", physical_input.pretty()))
+    return ExplainExec(rows)

@@ -231,6 +231,11 @@ def _create(plan: LogicalPlan, opts: PlannerOptions) -> PhysicalPlan:
         return EmptyExec(plan.produce_one_row)
 
     if isinstance(plan, Explain):
-        raise PlanError("Explain handled by the client layer")
+        # direct-call path (plan already optimized by the caller);
+        # execution.plan_logical captures the pre-optimization text too
+        from .explain import render_explain
+
+        return render_explain(plan.input, create_physical_plan(plan.input),
+                              plan.verbose)
 
     raise NotImplementedError_(f"no physical plan for {type(plan).__name__}")

@@ -34,12 +34,23 @@ from .proto import ballista_pb2 as pb
 
 
 def dtype_to_proto(dt: DataType) -> pb.DataType:
-    return pb.DataType(kind=dt.kind, scale=dt.scale)
+    p = pb.DataType(kind=dt.kind, scale=dt.scale)
+    if dt.kind == "list":
+        p.element_kind = dt.element.kind
+        p.element_scale = dt.element.scale
+        p.length = dt.length
+    return p
 
 
 def dtype_from_proto(p: pb.DataType) -> DataType:
     if p.kind == "decimal":
         return Decimal(p.scale)
+    if p.kind == "list":
+        from .datatypes import FixedSizeList
+
+        elem = (Decimal(p.element_scale) if p.element_kind == "decimal"
+                else DataType(p.element_kind))
+        return FixedSizeList(elem, p.length)
     return DataType(p.kind)
 
 
@@ -355,6 +366,7 @@ def plan_from_proto(n: pb.LogicalPlanNode) -> lp.LogicalPlan:
 
 def physical_to_proto(plan) -> pb.PhysicalPlanNode:
     from .physical.aggregate import HashAggregateExec
+    from .physical.explain import ExplainExec
     from .physical.join import JoinExec
     from .physical.mesh_agg import MeshAggExec, MeshJoinExec
     from .physical import operators as ops
@@ -440,6 +452,9 @@ def physical_to_proto(plan) -> pb.PhysicalPlanNode:
         n.unresolved_shuffle.partition_count = plan.partition_count
     elif isinstance(plan, ops.EmptyExec):
         n.empty.produce_one_row = plan.produce_one_row
+    elif isinstance(plan, ExplainExec):
+        n.explain.plan_type.extend(t for t, _ in plan.rows)
+        n.explain.plan.extend(p for _, p in plan.rows)
     else:
         raise SerdeError(f"cannot serialize physical plan {type(plan).__name__}")
     return n
@@ -539,6 +554,10 @@ def physical_from_proto(n: pb.PhysicalPlanNode):
         )
     if kind == "empty":
         return ops.EmptyExec(n.empty.produce_one_row)
+    if kind == "explain":
+        from .physical.explain import ExplainExec
+
+        return ExplainExec(list(zip(n.explain.plan_type, n.explain.plan)))
     raise SerdeError(f"unknown physical node {kind}")
 
 

@@ -15,8 +15,12 @@ KEYWORDS = {
     "anti", "on", "date", "interval", "extract", "union", "all", "exists",
     "create", "external", "table", "stored", "location", "with", "header",
     "row", "nulls", "first", "last", "true", "false", "offset", "using",
-    "explain", "verbose",
 }
+
+# Soft (contextual) keywords: only special at statement position, so
+# schemas with columns named e.g. ``verbose`` keep parsing (they lex as
+# plain identifiers; the parser matches them by value where relevant).
+SOFT_KEYWORDS = {"explain", "verbose"}
 
 TWO_CHAR_OPS = ("<=", ">=", "<>", "!=", "||")
 ONE_CHAR_OPS = "+-*/%(),.;=<>"

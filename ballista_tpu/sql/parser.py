@@ -77,7 +77,7 @@ class CreateExternalTable:
     has_header: bool = False
 
 
-Statement = object  # Query | CreateExternalTable
+Statement = object  # Query | ExplainStmt | CreateExternalTable
 
 
 def parse_sql(sql: str) -> Statement:
@@ -139,12 +139,24 @@ class Parser:
 
     # -- statements ---------------------------------------------------------
 
+    def _peek_soft(self, name: str) -> bool:
+        """Contextual keyword: an identifier matched by value, so the same
+        word stays usable as a column name elsewhere in the query."""
+        from .lexer import SOFT_KEYWORDS
+
+        assert name in SOFT_KEYWORDS, f"{name} not registered as soft kw"
+        t = self.peek()
+        return t.kind == "ident" and t.value.lower() == name
+
     def parse_statement(self) -> Statement:
         if self.peek().is_kw("create"):
             return self.parse_create_external_table()
-        if self.peek().is_kw("explain"):
+        if self._peek_soft("explain"):
             self.next()
-            verbose = self.accept_kw("verbose") is not None
+            verbose = False
+            if self._peek_soft("verbose"):
+                self.next()
+                verbose = True
             if not self.peek().is_kw("select"):
                 raise SqlError(
                     f"EXPLAIN expects SELECT, got {self.peek().value!r}")

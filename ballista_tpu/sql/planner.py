@@ -32,6 +32,7 @@ from ..errors import PlanError, SqlError
 from .. import expr as ex
 from ..logical import (
     Aggregate,
+    Explain,
     Filter,
     Join,
     Limit,
@@ -41,7 +42,9 @@ from ..logical import (
     TableScan,
     TableSource,
 )
-from .parser import JoinClause, OrderItem, Query, SelectItem, TableRef
+from .parser import (
+    ExplainStmt, JoinClause, OrderItem, Query, SelectItem, TableRef,
+)
 
 
 @dataclass
@@ -72,7 +75,11 @@ class SqlPlanner:
 
     # ------------------------------------------------------------------ API
 
-    def plan(self, q: Query) -> LogicalPlan:
+    def plan(self, q) -> LogicalPlan:
+        if isinstance(q, ExplainStmt):
+            # EXPLAIN [VERBOSE] <select>: wrap the planned query (reference
+            # surface: rust/core/proto/ballista.proto:232 ExplainNode)
+            return Explain(self.plan(q.query), q.verbose)
         if q.from_table is None:
             raise SqlError("SELECT without FROM not supported yet")
 

@@ -74,16 +74,21 @@ def instrument_q1(data_dir: str, runs: int):
 
     # -- stage: parse (file -> numpy physical arrays, native C++ scanner) --
     t0 = time.time()
-    n_total, arrays, dicts = 0, None, {}
+    n_total, arrays, dicts, valids = 0, None, {}, {}
     for p in range(src.num_partitions()):
         if src._use_native():
-            n, arrs, ds = src._scan_native(p, names)
+            n, arrs, ds, vs = src._scan_native(p, names)
         else:
-            n, arrs, ds = src._scan_pandas(p, names)
+            n, arrs, ds, vs = src._scan_pandas(p, names)
         if arrays is None:
-            arrays, dicts = arrs, ds
+            arrays, dicts, valids = arrs, ds, dict(vs or {})
             n_total = n
         else:  # multi-partition: host concat (parse-stage cost)
+            # validity masks default to all-true when a chunk lacks one
+            for k in set(valids) | set(vs or {}):
+                left = valids.get(k, np.ones(n_total, dtype=bool))
+                right = (vs or {}).get(k, np.ones(n, dtype=bool))
+                valids[k] = np.concatenate([left, right])
             arrays = {k: np.concatenate([arrays[k], arrs[k]])
                       for k in arrays}
             n_total += n
@@ -95,7 +100,8 @@ def instrument_q1(data_dir: str, runs: int):
     # -- stage: h2d (host numpy -> device buffers) --------------------------
     t0 = time.time()
     cap = round_capacity(n_total)
-    batch = ColumnBatch.from_numpy(sub, arrays, dicts, capacity=cap)
+    batch = ColumnBatch.from_numpy(sub, arrays, dicts, capacity=cap,
+                                   validity=valids or None)
     jax.block_until_ready([c.values for c in batch.columns])
     h2d_s = time.time() - t0
     out["h2d_s"] = round(h2d_s, 4)

@@ -1,0 +1,38 @@
+"""Gate test for bench.py's per-stage instrumentation.
+
+Round-4 regression: the scanner's return arity changed (validity masks
+added) and ``bench.instrument_q1`` silently broke — ``BENCH_r04.json``
+recorded ``stages_error`` instead of the parse/h2d/kernel decomposition.
+Nothing in the gate exercised the instrumentation, so this test runs it
+end-to-end on tiny data (SF0.002, 2 partitions so the multi-partition
+concat path is covered too) and asserts the stage fields are populated.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(scope="module")
+def tiny_data(tmp_path_factory):
+    from benchmarks.tpch import datagen
+
+    data_dir = str(tmp_path_factory.mktemp("bench_instr"))
+    datagen.generate(data_dir, scale=0.002, num_parts=2)
+    return data_dir
+
+
+def test_instrument_q1_populates_stages(tiny_data):
+    import bench
+
+    out = bench.instrument_q1(tiny_data, runs=1)
+    # parse / h2d / kernel triplet must all be present and positive
+    for key in ("parse_s", "parse_mb_per_s", "h2d_s", "rows",
+                "kernel_s", "kernel_rows_per_s", "kernel_aot_compile_s"):
+        assert key in out, f"missing stage field {key}: {out}"
+    assert out["rows"] > 0
+    assert out["kernel_s"] > 0
+    assert out["kernel_rows_per_s"] > 0
