@@ -17,8 +17,28 @@ Layering (mirrors reference SURVEY layer map, bottom-up):
 """
 
 import os as _os
+import sys as _sys
 
 import jax as _jax
+
+# pyarrow >= 25 defaults its memory pool to mimalloc, which intermittently
+# corrupts under this engine's thread mix (executor thread pools + grpc +
+# GIL-released ctypes scans): observed as flaky SIGSEGV inside pa.array
+# during shuffle writes, reproducibly gone under jemalloc or the system
+# allocator. Pin jemalloc BEFORE pyarrow's first import (the env var is
+# only read then); if the application imported pyarrow already, flip the
+# default pool at runtime instead. An explicit ARROW_DEFAULT_MEMORY_POOL
+# from the user always wins.
+if "ARROW_DEFAULT_MEMORY_POOL" not in _os.environ:
+    if "pyarrow" in _sys.modules:
+        try:
+            import pyarrow as _pa
+
+            _pa.set_memory_pool(_pa.jemalloc_memory_pool())
+        except Exception:  # noqa: BLE001 - jemalloc absent in this build
+            pass
+    else:
+        _os.environ["ARROW_DEFAULT_MEMORY_POOL"] = "jemalloc"
 
 # Exact decimal arithmetic uses scaled int64 columns; without x64, JAX would
 # silently downcast them to int32. Float64 device arrays are never created

@@ -162,7 +162,92 @@ class DataPlaneServer(socketserver.ThreadingTCPServer):
         self.server_close()
 
 
-def start_data_plane(host: str, port: int, work_dir: str) -> DataPlaneServer:
+class NativeDataPlane:
+    """The C++ shuffle server (native/shuffle_server.cpp) as the
+    production data plane: a thread-per-connection daemon with zero GIL
+    involvement, so partition serving never contends with task execution
+    in the executor process (the reference's equivalent is the tokio
+    Flight service, rust/executor/src/flight_service.rs:193-228). Same
+    wire protocol and path layout as ``DataPlaneServer``."""
+
+    def __init__(self, port: int, work_dir: str, bind_host: str = ""):
+        import subprocess
+
+        bin_path = _native_server_bin()
+        if bin_path is None:
+            raise IoError("native shuffle server not built")
+        cmd = [bin_path, str(port), work_dir]
+        if bind_host:
+            cmd.append(bind_host)
+        # The binary arms PR_SET_PDEATHSIG itself (shuffle_server.cpp
+        # main), so a SIGKILLed executor can't orphan a daemon wedging
+        # the configured port — and no preexec_fn is needed here (fork
+        # hooks deadlock under multithreaded jax).
+        self._proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        line = self._proc.stdout.readline()
+        try:
+            self.port = int(line.split("port")[1].split()[0])
+        except (IndexError, ValueError):
+            self._proc.terminate()
+            self._proc.wait(timeout=5)
+            raise IoError(
+                f"native shuffle server failed to start: {line!r}")
+        self.work_dir = work_dir
+
+    def close(self):
+        self._proc.terminate()
+        try:
+            self._proc.wait(timeout=5)
+        except Exception:  # noqa: BLE001 - escalate to SIGKILL
+            self._proc.kill()
+            self._proc.wait(timeout=5)
+
+
+def _native_server_bin() -> Optional[str]:
+    """Path to the built shuffle_server binary (built on demand alongside
+    the native scanner; both come from `make -C ballista_tpu/native`)."""
+    native_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "native",
+    )
+    bin_path = os.path.join(native_dir, "shuffle_server")
+    if os.path.exists(bin_path):
+        return bin_path
+    from ..io import native as native_scan
+
+    if native_scan._try_build() and os.path.exists(bin_path):
+        return bin_path
+    return None
+
+
+def native_dataplane_enabled(value: Optional[str] = None) -> bool:
+    """Single parse rule for the data-plane selector (env or config):
+    'off'/'0'/'false' (any case) disables the native daemon."""
+    if value is None:
+        value = os.environ.get("BALLISTA_NATIVE_DATAPLANE", "on")
+    return str(value).lower() not in ("off", "0", "false")
+
+
+def start_data_plane(host: str, port: int, work_dir: str,
+                     native: Optional[bool] = None):
+    """Start the shuffle data plane; returns an object with .port/.close().
+
+    The native C++ daemon is the default; ``BALLISTA_NATIVE_DATAPLANE=off``
+    (or native=False) selects the in-process Python server, which also
+    remains the automatic fallback when the binary can't be built."""
+    if native is None:
+        native = native_dataplane_enabled()
+    if native:
+        try:
+            return NativeDataPlane(port, work_dir, bind_host=host)
+        except Exception as e:  # noqa: BLE001 - fall back to Python server
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "native data plane unavailable (%s); using Python server", e)
     server = DataPlaneServer(host, port, work_dir)
     t = threading.Thread(target=server.serve_forever, daemon=True,
                          name="ballista-data-plane")

@@ -51,13 +51,16 @@ class ExecutorConfig:
                  scheduler_host: str = "localhost",
                  scheduler_port: int = 50050,
                  bind_host: Optional[str] = None,
-                 num_devices: int = 1):
+                 num_devices: int = 1,
+                 native_dataplane: Optional[bool] = None):
         # host = the address peers should dial (advertised in PollWork);
         # bind_host = the local interface the data plane listens on.
         # Distinct so NAT/port-forward setups can bind 0.0.0.0 while
         # advertising an external address.
         self.host = host
         self.bind_host = bind_host if bind_host is not None else host
+        # None = resolve from BALLISTA_NATIVE_DATAPLANE (default: native)
+        self.native_dataplane = native_dataplane
         self.port = port
         # devices this executor owns (reported in PollWork metadata;
         # mesh fusion is driven by these fleet reports — a client
@@ -78,7 +81,8 @@ class Executor:
         self.mesh_group = mesh_group
         self.id = str(uuid.uuid4())
         self._data_plane = start_data_plane(
-            config.bind_host, config.port, config.work_dir
+            config.bind_host, config.port, config.work_dir,
+            native=config.native_dataplane,
         )
         self.port = self._data_plane.port
         self._client = SchedulerClient(config.scheduler_host,

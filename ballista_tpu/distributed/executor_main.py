@@ -32,6 +32,9 @@ DEFAULTS = {
     "mesh_group_channel": "",  # leader's task channel (host:port);
     #                            leader binds it, followers dial it
     "mesh_local_devices": 0,  # virtual CPU devices per process (tests)
+    # C++ shuffle-server daemon serves the data plane (GIL-free); "off"
+    # keeps the in-process Python server (also the automatic fallback)
+    "native_dataplane": "on",
     "log_level": "INFO",
 }
 
@@ -55,6 +58,7 @@ def main(argv=None) -> int:
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
 
+    from .dataplane import native_dataplane_enabled as _native_enabled
     from .executor import Executor, ExecutorConfig
 
     group_size = int(cfg["mesh_group_size"])
@@ -120,6 +124,7 @@ def main(argv=None) -> int:
         scheduler_host="localhost" if args.local else cfg["scheduler_host"],
         scheduler_port=scheduler_port,
         num_devices=num_devices,
+        native_dataplane=_native_enabled(cfg["native_dataplane"]),
     )
     executor = Executor(exec_cfg, mesh_group=leader)
     executor.start()

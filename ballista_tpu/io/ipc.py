@@ -29,9 +29,25 @@ from ..datatypes import Field, Schema
 from ..errors import IoError
 
 
+_POOL_CHECKED = False
+
+
 def _arrow():
     import pyarrow as pa
 
+    global _POOL_CHECKED
+    if not _POOL_CHECKED:
+        _POOL_CHECKED = True
+        # mimalloc (pyarrow's default pool) intermittently corrupts under
+        # this engine's thread mix (see ballista_tpu/__init__.py). The env
+        # selector set there is inert on builds without jemalloc, so
+        # verify at first use and degrade to the system allocator.
+        try:
+            if (pa.default_memory_pool().backend_name == "mimalloc"
+                    and not os.environ.get("BALLISTA_ALLOW_MIMALLOC")):
+                pa.set_memory_pool(pa.system_memory_pool())
+        except Exception:  # noqa: BLE001 - keep whatever pool exists
+            pass
     return pa
 
 

@@ -88,6 +88,10 @@ def _load():
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
             ctypes.c_char, ctypes.c_int,
         ]
+        lib.tbl_open_range.restype = ctypes.c_void_p
+        lib.tbl_open_range.argtypes = lib.tbl_open.argtypes + [
+            ctypes.c_int64, ctypes.c_int64,
+        ]
         lib.tbl_error.restype = ctypes.c_char_p
         lib.tbl_error.argtypes = [ctypes.c_void_p]
         lib.tbl_num_rows.restype = ctypes.c_int64
@@ -131,12 +135,19 @@ def scan_file(
     wanted: Sequence[str],
     delimiter: str = "|",
     skip_header: bool = False,
+    offset: int = 0,
+    max_bytes: int = -1,
 ) -> Tuple[int, Dict[str, np.ndarray], Dict[str, np.ndarray],
            Dict[str, np.ndarray]]:
-    """Parse one file natively. Returns (num_rows, physical arrays,
-    raw dictionary values per utf8 column — sorted, codes ordinal,
-    validity bool arrays for columns that saw SQL NULLs — empty
-    non-string fields; all-valid columns are absent from the dict)."""
+    """Parse one file (or a byte range of it) natively. Returns (num_rows,
+    physical arrays, raw dictionary values per utf8 column — sorted, codes
+    ordinal, validity bool arrays for columns that saw SQL NULLs — empty
+    non-string fields; all-valid columns are absent from the dict).
+
+    Range semantics (offset/max_bytes): rows start at the first line
+    boundary after ``offset`` and include every row beginning before
+    ``offset + max_bytes``, so adjacent ranges partition the file's rows
+    exactly (bounded-RAM streaming / parallel chunk workers)."""
     lib = _load()
     if lib is None:
         raise IoError("native scanner not built")
@@ -148,8 +159,9 @@ def scan_file(
     widx = [schema.index_of(n) for n in wanted]
     wantarr = (ctypes.c_int32 * max(len(widx), 1))(*(widx or [0]))
 
-    h = lib.tbl_open(path.encode(), ncols, kinds, scales, wantarr, len(widx),
-                     delimiter.encode()[0:1], 1 if skip_header else 0)
+    h = lib.tbl_open_range(path.encode(), ncols, kinds, scales, wantarr,
+                           len(widx), delimiter.encode()[0:1],
+                           1 if skip_header else 0, offset, max_bytes)
     try:
         err = lib.tbl_error(h)
         if err:

@@ -289,9 +289,13 @@ void* accept_loop(void* argp) {
 
 extern "C" {
 
-// Starts the server on a background thread. Returns the bound port (>0) or
-// a negative errno. port=0 picks an ephemeral port.
-int start_shuffle_server(int port, const char* work_dir) {
+// Starts the server on a background thread bound to ``bind_host`` (numeric
+// IPv4, "localhost", or ""/"0.0.0.0" for INADDR_ANY — matching the Python
+// DataPlaneServer's bind semantics so loopback-only deployments stay
+// loopback-only). Returns the bound port (>0) or a negative errno. port=0
+// picks an ephemeral port.
+int start_shuffle_server_bind(int port, const char* work_dir,
+                              const char* bind_host) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -errno;
   int one = 1;
@@ -299,6 +303,15 @@ int start_shuffle_server(int port, const char* work_dir) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (bind_host != nullptr && bind_host[0] != '\0' &&
+      strcmp(bind_host, "0.0.0.0") != 0) {
+    if (strcmp(bind_host, "localhost") == 0) {
+      addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    } else if (inet_pton(AF_INET, bind_host, &addr.sin_addr) != 1) {
+      close(fd);
+      return -EINVAL;
+    }
+  }
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0) {
     int e = errno;
@@ -319,21 +332,48 @@ int start_shuffle_server(int port, const char* work_dir) {
   return ntohs(addr.sin_port);
 }
 
+int start_shuffle_server(int port, const char* work_dir) {
+  return start_shuffle_server_bind(port, work_dir, nullptr);
+}
+
 }  // extern "C"
 
 #ifndef NO_MAIN
+#include <sys/prctl.h>
+#include <csignal>
+
 int main(int argc, char** argv) {
-  if (argc != 3) {
-    fprintf(stderr, "usage: %s <port> <work_dir>\n", argv[0]);
+  if (argc != 3 && argc != 4) {
+    fprintf(stderr, "usage: %s <port> <work_dir> [bind_host]\n", argv[0]);
     return 2;
   }
-  int port = start_shuffle_server(atoi(argv[1]), argv[2]);
+  // die with the spawning executor: an abnormally-killed parent must not
+  // orphan a daemon holding the configured port (opt out for standalone
+  // runs with SHUFFLE_SERVER_PDEATHSIG=0)
+  const bool tie_to_parent = [] {
+    const char* pd = getenv("SHUFFLE_SERVER_PDEATHSIG");
+    return pd == nullptr || strcmp(pd, "0") != 0;
+  }();
+  if (tie_to_parent) {
+    prctl(PR_SET_PDEATHSIG, SIGTERM);
+  }
+  int port = start_shuffle_server_bind(atoi(argv[1]), argv[2],
+                                       argc == 4 ? argv[3] : nullptr);
   if (port < 0) {
     fprintf(stderr, "bind failed: %s\n", strerror(-port));
     return 1;
   }
   printf("ballista-tpu shuffle server on port %d serving %s\n", port, argv[2]);
   fflush(stdout);
+  if (tie_to_parent) {
+    // PDEATHSIG can be inert under some sandboxes/kernels, so also poll:
+    // reparenting (getppid changes) means the spawning executor is gone
+    const pid_t original_parent = getppid();
+    for (;;) {
+      sleep(2);
+      if (getppid() != original_parent) return 0;
+    }
+  }
   pause();
   return 0;
 }

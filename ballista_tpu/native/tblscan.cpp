@@ -219,9 +219,16 @@ extern "C" {
 // Returns an opaque Table*; on fatal error returns a Table with error set
 // (check tbl_error). wanted: indices of columns to materialize; others are
 // parsed-past. delimiter: e.g. '|'; skip_header: 1 to drop first line.
-void* tbl_open(const char* path, int ncols, const int32_t* kinds,
-               const int32_t* scales, const int32_t* wanted, int nwanted,
-               char delimiter, int skip_header) {
+//
+// Byte-range scans (offset/max_bytes) enable bounded-RAM streaming over
+// arbitrarily large files and parallel chunk workers: an offset > 0
+// starts at the first line boundary AFTER offset, and parsing runs to
+// the first line boundary at/after offset+max_bytes (max_bytes < 0 =
+// EOF). Adjacent ranges therefore partition the file's rows exactly.
+void* tbl_open_range(const char* path, int ncols, const int32_t* kinds,
+                     const int32_t* scales, const int32_t* wanted,
+                     int nwanted, char delimiter, int skip_header,
+                     int64_t offset, int64_t max_bytes) {
   auto* t = new Table();
   t->cols.resize(static_cast<size_t>(ncols));
   std::vector<char> want(static_cast<size_t>(ncols), 0);
@@ -239,7 +246,7 @@ void* tbl_open(const char* path, int ncols, const int32_t* kinds,
   struct stat st;
   fstat(fd, &st);
   size_t size = static_cast<size_t>(st.st_size);
-  if (size == 0) {
+  if (size == 0 || offset >= static_cast<int64_t>(size)) {
     close(fd);
     return t;
   }
@@ -253,13 +260,29 @@ void* tbl_open(const char* path, int ncols, const int32_t* kinds,
 
   const char* p = data;
   const char* end = data + size;
-  if (skip_header) {
+  if (offset > 0) {
+    // a row belongs to the range containing its FIRST byte: start at the
+    // first row whose start position is >= offset, i.e. just after the
+    // first newline at position >= offset-1 (a row starting exactly at
+    // `offset` has its preceding newline at offset-1 and is ours; a row
+    // straddling the boundary started earlier and belongs to the
+    // previous range, which parses rows it BEGINS to their full line)
+    p = data + (offset - 1);
+    const char* nl = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    p = (nl == nullptr) ? end : nl + 1;
+  }
+  const char* stop = end;  // parse rows that BEGIN before stop
+  if (max_bytes >= 0 && offset + max_bytes < static_cast<int64_t>(size)) {
+    stop = data + offset + max_bytes;
+  }
+  if (skip_header && offset == 0) {
     while (p < end && *p != '\n') ++p;
     if (p < end) ++p;
   }
   const char delim = delimiter;
   int64_t row = 0;
-  while (p < end) {
+  while (p < stop) {  // a row that BEGINS before stop parses to its EOL
     // line end first (SIMD memchr), so field scans are bounded by it and
     // a malformed short line can never bleed into the next row
     const char* nl = static_cast<const char*>(
@@ -296,6 +319,13 @@ void* tbl_open(const char* path, int ncols, const int32_t* kinds,
   for (auto& c : t->cols)
     if (c.kind == 4) sort_dictionary(c);
   return t;
+}
+
+void* tbl_open(const char* path, int ncols, const int32_t* kinds,
+               const int32_t* scales, const int32_t* wanted, int nwanted,
+               char delimiter, int skip_header) {
+  return tbl_open_range(path, ncols, kinds, scales, wanted, nwanted,
+                        delimiter, skip_header, 0, -1);
 }
 
 const char* tbl_error(void* h) {

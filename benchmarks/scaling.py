@@ -1,0 +1,199 @@
+"""1->N device scaling for the fused ICI shuffle operators.
+
+Measures the BASELINE metric's unmeasured half ("1->8 chip shuffle
+scaling efficiency"): the same fused MeshAggExec / MeshJoinExec SPMD
+programs the scheduler produces (lax.all_to_all row exchange + per-device
+final op) run over meshes of 1/2/4/8 devices.
+
+Two curves per operator:
+- weak scaling: rows-per-device fixed, total data grows with N
+  (efficiency = t1 / tN, ideal 1.0 — the shuffle's all_to_all volume per
+  device is constant);
+- strong scaling: total rows fixed, split N ways
+  (efficiency = t1 / (N * tN), ideal 1.0).
+
+On the virtual CPU mesh all N devices share host cores, so wall-clock
+efficiency there mainly validates that per-device *work* shrinks and the
+collective path compiles/executes at every N; chip-true numbers come from
+running the same script on real multi-device hardware
+(JAX_PLATFORMS=tpu BALLISTA_SCALING_DEVICES=...).
+
+Reference anchor: stage-parallel shuffle scheduling,
+rust/scheduler/src/planner.rs:292-330.
+
+Usage: python benchmarks/scaling.py [--rows-per-dev 262144]
+           [--total-rows 1048576] [--runs 3] [--out results.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# default to the virtual CPU mesh: the ambient environment often points
+# JAX at a single remote TPU chip, useless for 1..8-device curves. Real
+# hardware runs opt in with BALLISTA_SCALING_TPU=1 (+ JAX_PLATFORMS).
+if os.environ.get("BALLISTA_SCALING_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if ("xla_force_host_platform_device_count" not in flags
+        and os.environ["JAX_PLATFORMS"] == "cpu"):
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+# sitecustomize may have imported jax before this script ran (with the
+# ambient platform already latched), so the env var alone is too late —
+# config.update is what actually flips the backend (see tests/conftest.py)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def _agg_exec(n_dev: int, rows: int, n_groups: int = 4096):
+    """Production-shaped MeshAggExec: scan -> partial agg producer per
+    partition, ICI all_to_all on the group key, per-device final agg."""
+    from ballista_tpu import col, count, sum_
+    from ballista_tpu.distributed.planner import DistributedPlanner
+    from ballista_tpu.distributed.scheduler import _fuse_mesh_stages
+    from ballista_tpu.io import MemTableSource
+    from ballista_tpu.logical import LogicalPlanBuilder
+    from ballista_tpu.physical.mesh_agg import MeshAggExec
+    from ballista_tpu.physical.planner import (
+        PlannerOptions, create_physical_plan,
+    )
+    from ballista_tpu import schema as mk_schema, Int64
+
+    rng = np.random.default_rng(11)
+    s = mk_schema(("k", Int64), ("v", Int64))
+    src = MemTableSource.from_pydict(
+        s,
+        {"k": rng.integers(0, n_groups, rows),
+         "v": rng.integers(0, 1000, rows)},
+        num_partitions=max(n_dev, 1),
+    )
+    plan = (
+        LogicalPlanBuilder.scan("t", src)
+        .aggregate([col("k")], [sum_(col("v")).alias("sv"),
+                                count().alias("n")])
+        .build()
+    )
+    phys = create_physical_plan(plan, PlannerOptions(agg_partitions=max(n_dev, 2)))
+    stages = DistributedPlanner().plan_query_stages("scale", phys)
+    # fusion gates on a cluster mesh of >= 2; the n=1 baseline point
+    # reuses the fused node shape with a 1-device mesh (all_to_all is
+    # identity there), so every N runs the identical SPMD program
+    fused = _fuse_mesh_stages(stages, max(n_dev, 2))
+    node = fused[-1].child
+    assert isinstance(node, MeshAggExec), type(node)
+    if node.n_devices != n_dev:
+        node = MeshAggExec(node.producer, node.group_exprs, node.agg_exprs,
+                           node.hash_exprs, n_dev, node.group_capacity)
+    return node
+
+
+def _join_exec(n_dev: int, rows: int):
+    """MeshJoinExec: both sides hashed over the mesh + per-device join."""
+    from ballista_tpu.io import MemTableSource
+    from ballista_tpu.physical.mesh_agg import MeshJoinExec
+    from ballista_tpu.physical.operators import ScanExec
+    from ballista_tpu import schema as mk_schema, Int64
+
+    rng = np.random.default_rng(13)
+    n_keys = max(rows // 4, 16)
+    bs = mk_schema(("bk", Int64), ("bv", Int64))
+    ps = mk_schema(("pk_", Int64), ("pv", Int64))
+    build = MemTableSource.from_pydict(
+        bs,
+        {"bk": np.arange(n_keys, dtype=np.int64),
+         "bv": rng.integers(0, 1000, n_keys)},
+        num_partitions=max(n_dev, 1),
+    )
+    probe = MemTableSource.from_pydict(
+        ps,
+        {"pk_": rng.integers(0, n_keys, rows),
+         "pv": rng.integers(0, 1000, rows)},
+        num_partitions=max(n_dev, 1),
+    )
+    return MeshJoinExec(ScanExec("b", build), ScanExec("p", probe),
+                        [("bk", "pk_")], "inner", n_dev)
+
+
+def _time_exec(node, runs: int):
+    """(first_run_s incl. compile, min warm s). Consumes all batches."""
+    import jax
+
+    def once():
+        t0 = time.time()
+        for b in node.execute(0):
+            jax.block_until_ready([c.values for c in b.columns])
+        return time.time() - t0
+
+    first = once()
+    warm = min(once() for _ in range(max(runs, 2)))
+    return first, warm
+
+
+def run_curves(dev_counts, rows_per_dev: int, total_rows: int, runs: int):
+    import jax
+
+    out = {
+        "platform": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+        "n_devices_available": len(jax.devices()),
+        "rows_per_dev": rows_per_dev,
+        "total_rows": total_rows,
+        "curves": {},
+    }
+    for op_name, make in (("mesh_agg", _agg_exec), ("mesh_join", _join_exec)):
+        for mode in ("weak", "strong"):
+            rows_list = []
+            for n in dev_counts:
+                rows = rows_per_dev * n if mode == "weak" else total_rows
+                node = make(n, rows)
+                first, warm = _time_exec(node, runs)
+                rows_list.append({
+                    "n_devices": n, "rows": rows,
+                    "first_s": round(first, 4), "warm_s": round(warm, 4),
+                    "rows_per_s": round(rows / warm, 1),
+                })
+                print(f"# {op_name} {mode} n={n} rows={rows} "
+                      f"warm={warm:.4f}s", file=sys.stderr)
+            t1 = rows_list[0]["warm_s"]
+            for r in rows_list:
+                n = r["n_devices"]
+                r["efficiency"] = round(
+                    t1 / r["warm_s"] if mode == "weak"
+                    else t1 / (n * r["warm_s"]), 3)
+            out["curves"][f"{op_name}_{mode}"] = rows_list
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows-per-dev", type=int, default=262_144)
+    ap.add_argument("--total-rows", type=int, default=1_048_576)
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--devices", default=os.environ.get(
+        "BALLISTA_SCALING_DEVICES", "1,2,4,8"))
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    dev_counts = [int(x) for x in args.devices.split(",") if x]
+    result = run_curves(dev_counts, args.rows_per_dev, args.total_rows,
+                        args.runs)
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,145 @@
+"""Large-scale-factor TPC-H runs (BASELINE.json configs 2-3: q1/q3 at
+SF=10) with pandas-oracle verification, emitting a JSON artifact.
+
+The engine path exercises the bounded-RAM streaming scan
+(io/text.py STREAM_CHUNK_BYTES byte-range chunks through the native C++
+scanner) — the machinery that breaks the old whole-file-in-RAM SF=1
+ceiling. The oracle is an independent pandas computation over the same
+files (benchmarks/tpch/oracle.py), so correctness at scale is asserted,
+not assumed.
+
+Usage: python benchmarks/sf_run.py --data bench_data/sf10 \
+           [--queries q1,q3] [--runs 2] [--no-oracle] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+if os.environ.get("BALLISTA_SF_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+QDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tpch",
+                    "queries")
+
+# tables each query's oracle needs (loading all 8 at SF=10 wastes RAM/time)
+ORACLE_TABLES = {
+    "q1": ["lineitem"],
+    "q3": ["customer", "orders", "lineitem"],
+    "q5": ["customer", "orders", "lineitem", "supplier", "nation", "region"],
+    "q6": ["lineitem"],
+}
+
+
+def _normalize(df):
+    out = df.copy()
+    for c in out.columns:
+        if out[c].dtype.kind == "M":
+            out[c] = out[c].values.astype("datetime64[D]")
+    return out.reset_index(drop=True)
+
+
+def run_query(ctx, qname: str, runs: int):
+    sql = open(os.path.join(QDIR, f"{qname}.sql")).read()
+    t0 = time.time()
+    out = ctx.sql(sql).collect()
+    first = time.time() - t0
+    times = []
+    for _ in range(max(runs - 1, 1)):
+        t0 = time.time()
+        out = ctx.sql(sql).collect()
+        times.append(time.time() - t0)
+    return out, first, min(times)
+
+
+def check_oracle(data_dir: str, qname: str, got) -> str:
+    import pandas as pd
+
+    from benchmarks.tpch import oracle
+
+    tables = oracle.load_tables(data_dir, only=ORACLE_TABLES.get(qname))
+    exp = _normalize(oracle.ORACLES[qname](tables))
+    got = _normalize(got)
+    assert list(got.columns) == list(exp.columns), (got.columns, exp.columns)
+    assert len(got) == len(exp), f"{qname}: {len(got)} vs {len(exp)} rows"
+    for c in exp.columns:
+        g, e = got[c], exp[c]
+        if e.dtype.kind in "fc":
+            np.testing.assert_allclose(g.astype(float), e.astype(float),
+                                       rtol=1e-5, atol=1e-5,
+                                       err_msg=f"{qname}.{c}")
+        else:
+            assert list(g.astype(str)) == list(e.astype(str)), f"{qname}.{c}"
+    return "ok"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--queries", default="q1,q3")
+    ap.add_argument("--runs", type=int, default=2)
+    ap.add_argument("--no-oracle", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from ballista_tpu.client import BallistaContext
+    from benchmarks.tpch.schema_def import register_tpch
+
+    lineitem_rows = 0
+    d = os.path.join(args.data, "lineitem")
+    for f in os.listdir(d):
+        if f.endswith(".tbl"):
+            with open(os.path.join(d, f), "rb") as fh:
+                lineitem_rows += sum(
+                    buf.count(b"\n")
+                    for buf in iter(lambda: fh.read(1 << 20), b""))
+
+    result = {
+        "data": args.data,
+        "platform": jax.devices()[0].platform,
+        "lineitem_rows": lineitem_rows,
+        "queries": {},
+    }
+    for qname in args.queries.split(","):
+        qname = qname.strip()
+        # fresh context per query: holds only this query's cache
+        ctx = BallistaContext.standalone()
+        register_tpch(ctx, args.data, "tbl")
+        out, first, warm = run_query(ctx, qname, args.runs)
+        entry = {
+            "first_s": round(first, 2),
+            "warm_s": round(warm, 2),
+            "rows_out": int(len(out)),
+            "lineitem_rows_per_s_first": round(lineitem_rows / first, 1),
+        }
+        print(f"# {qname}: first={first:.2f}s warm={warm:.2f}s "
+              f"rows={len(out)}", file=sys.stderr)
+        if not args.no_oracle:
+            t0 = time.time()
+            entry["oracle"] = check_oracle(args.data, qname, out)
+            entry["oracle_s"] = round(time.time() - t0, 1)
+            print(f"# {qname}: oracle ok ({entry['oracle_s']}s)",
+                  file=sys.stderr)
+        result["queries"][qname] = entry
+        del ctx
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

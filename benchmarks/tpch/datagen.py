@@ -1,4 +1,4 @@
-"""TPC-H-like data generator (numpy, vectorized).
+"""TPC-H-like data generator (numpy, vectorized, STREAMING).
 
 Produces dbgen-compatible ``.tbl`` layout (| separated, trailing |) with the
 standard schemas, row-count ratios, and value distributions/correlations the
@@ -6,7 +6,14 @@ benchmark queries rely on (date-correlated returnflag/linestatus, price =
 f(partkey), etc.). It is NOT bit-identical to official dbgen (different
 RNG), so golden results come from the pandas oracle in oracle.py rather
 than the spec's answer sets. Reference equivalent: dockerized dbgen
-(reference: rust/benchmarks/tpch/tpch-gen.sh:1-16).
+(reference: rust/benchmarks/tpch/tpch-gen.sh:1-16; partitioned generation
+like the convert step at rust/benchmarks/tpch/src/main.rs:196-265).
+
+Generation is CHUNKED: large tables are produced and written in bounded
+slices (``chunk_rows`` orders / parts / customers at a time), with chunk
+slices appended round-robin across the partition files. Peak RSS is a few
+hundred MB regardless of scale factor, so SF=10+ generates on a laptop;
+the monolithic whole-table-in-RAM layout capped out near SF=1.
 """
 
 from __future__ import annotations
@@ -69,40 +76,128 @@ def _money(rng, n, lo, hi):
     return rng.integers(int(lo * 100), int(hi * 100), n) / 100.0
 
 
-def _write_tbl(path, cols, num_parts=1):
-    """Write columns (list of np arrays) as .tbl partition files."""
-    n = len(cols[0])
-    os.makedirs(path, exist_ok=True)
-    per = -(-n // num_parts)
-    for p in range(num_parts):
-        lo, hi = p * per, min((p + 1) * per, n)
-        if lo >= hi and p > 0:
-            continue
-        strs = []
-        for c in cols:
-            if np.issubdtype(np.asarray(c).dtype, np.floating):
-                strs.append(np.char.mod("%.2f", c[lo:hi]))
-            elif np.asarray(c).dtype.kind == "M":  # datetime64
-                strs.append(np.datetime_as_string(c[lo:hi], unit="D"))
-            else:
-                strs.append(np.asarray(c[lo:hi]).astype(str))
-        lines = strs[0]
-        for s in strs[1:]:
-            lines = np.char.add(np.char.add(lines, "|"), s)
-        lines = np.char.add(lines, "|")
-        with open(os.path.join(path, f"partition{p}.tbl"), "w") as f:
-            f.write("\n".join(lines.tolist()))
-            f.write("\n")
+def _format_lines(cols) -> str:
+    """Columns (np arrays, equal length) -> '|'-joined .tbl text block."""
+    strs = []
+    for c in cols:
+        a = np.asarray(c)
+        if np.issubdtype(a.dtype, np.floating):
+            strs.append(np.char.mod("%.2f", a))
+        elif a.dtype.kind == "M":  # datetime64
+            strs.append(np.datetime_as_string(a, unit="D"))
+        else:
+            strs.append(a.astype(str))
+    lines = strs[0]
+    for s in strs[1:]:
+        lines = np.char.add(np.char.add(lines, "|"), s)
+    lines = np.char.add(lines, "|")
+    return "\n".join(lines.tolist()) + "\n"
+
+
+class _TableWriter:
+    """Appends chunk column-slices round-robin across partition files.
+
+    Files are truncated on first touch so regeneration never appends to a
+    previous run's output."""
+
+    def __init__(self, path: str, num_parts: int):
+        os.makedirs(path, exist_ok=True)
+        for f in os.listdir(path):
+            if f.endswith(".tbl"):
+                os.unlink(os.path.join(path, f))
+        self._paths = [os.path.join(path, f"partition{p}.tbl")
+                       for p in range(num_parts)]
+        for p in self._paths:  # every partition file exists even if empty
+            open(p, "w").close()
+        self._next = 0
+
+    def write_chunk(self, cols) -> None:
+        if len(np.asarray(cols[0])) == 0:
+            return
+        text = _format_lines(cols)
+        with open(self._paths[self._next], "a") as f:
+            f.write(text)
+        self._next = (self._next + 1) % len(self._paths)
+
+
+def _write_tbl(path, cols, num_parts=1, chunk_rows: int = 0):
+    """Write columns as .tbl partition files (chunked when asked)."""
+    n = len(np.asarray(cols[0]))
+    w = _TableWriter(path, num_parts)
+    step = chunk_rows or max(n, 1)
+    # split into >= num_parts slices so every partition file gets rows
+    step = min(step, -(-n // num_parts)) if n else step
+    lo = 0
+    while lo < n:
+        hi = min(lo + step, n)
+        w.write_chunk([np.asarray(c)[lo:hi] for c in cols])
+        lo = hi
+
+
+def _gen_orders_chunk(rng, lo, hi, n_cust, n_part, n_supp):
+    """Generate orders rows [lo, hi) plus their lineitems (both as column
+    lists). Self-contained per chunk: lineitem attributes derive from this
+    chunk's orders only, so peak memory is O(chunk)."""
+    n = hi - lo
+    okey = (np.arange(lo, hi) + 1) * 4 - 3  # sparse keys like dbgen
+    o_cust = rng.integers(1, n_cust + 1, n)
+    span = int((END_ORDER - START) / np.timedelta64(1, "D"))
+    o_date = START + rng.integers(0, span, n).astype("timedelta64[D]")
+    orders_cols = [
+        okey, o_cust,
+        rng.choice(["O", "F", "P"], n, p=[0.49, 0.49, 0.02]),
+        _money(rng, n, 1000.0, 400000.0),
+        o_date,
+        rng.choice(PRIORITIES, n),
+        np.char.add("Clerk#", rng.integers(1, 1000, n).astype(str)),
+        np.zeros(n, dtype=np.int64),
+        _comments(rng, n),
+    ]
+
+    n_lines_per = rng.integers(1, 8, n)
+    l_okey = np.repeat(okey, n_lines_per)
+    l_odate = np.repeat(o_date, n_lines_per)
+    n_li = len(l_okey)
+    l_pkey = rng.integers(1, n_part + 1, n_li)
+    l_skey = ((l_pkey - 1 + rng.integers(0, 4, n_li) * (n_supp // 4 + 1))
+              % n_supp) + 1
+    # l_linenumber: 1..k within each order, vectorized
+    starts = np.cumsum(n_lines_per) - n_lines_per
+    l_lnum = np.arange(n_li) - np.repeat(starts, n_lines_per) + 1
+    qty = rng.integers(1, 51, n_li)
+    retail_of = (90000 + (l_pkey % 20001) + 100 * (l_pkey % 1000)) / 100.0
+    eprice = np.round(qty * retail_of, 2)
+    disc = rng.integers(0, 11, n_li) / 100.0
+    tax = rng.integers(0, 9, n_li) / 100.0
+    sdate = l_odate + rng.integers(1, 122, n_li).astype("timedelta64[D]")
+    cdate = l_odate + rng.integers(30, 91, n_li).astype("timedelta64[D]")
+    rdate = sdate + rng.integers(1, 31, n_li).astype("timedelta64[D]")
+    returned = rdate <= CUTOFF
+    rflag = np.where(returned,
+                     np.where(rng.random(n_li) < 0.5, "R", "A"), "N")
+    lstatus = np.where(sdate > CUTOFF, "O", "F")
+    lineitem_cols = [
+        l_okey, l_pkey, l_skey, l_lnum,
+        qty.astype(np.float64), eprice, disc, tax,
+        rflag, lstatus, sdate, cdate, rdate,
+        rng.choice(INSTRUCTIONS, n_li),
+        rng.choice(SHIPMODES, n_li),
+        _comments(rng, n_li),
+    ]
+    return orders_cols, lineitem_cols
 
 
 def generate(data_dir: str, scale: float = 0.01, num_parts: int = 2,
-             seed: int = 7) -> None:
+             seed: int = 7, chunk_rows: int = 500_000) -> None:
+    """Generate all 8 tables at ``scale`` into ``data_dir``.
+
+    ``chunk_rows`` bounds how many orders/parts/customers are materialized
+    at once (lineitem ~4x that); RAM stays O(chunk_rows) at any scale."""
     rng = np.random.default_rng(seed)
     n_cust = max(int(150_000 * scale), 10)
     n_ord = n_cust * 10
     n_part = max(int(200_000 * scale), 20)
     n_supp = max(int(10_000 * scale), 5)
-    n_psupp = n_part * 4
 
     # region / nation ------------------------------------------------------
     _write_tbl(os.path.join(data_dir, "region"), [
@@ -124,100 +219,78 @@ def generate(data_dir: str, scale: float = 0.01, num_parts: int = 2,
         _phones(rng, n_supp),
         _money(rng, n_supp, -999.99, 9999.99),
         _comments(rng, n_supp),
-    ], 1)
+    ], 1, chunk_rows)
 
-    # customer -------------------------------------------------------------
-    ckey = np.arange(1, n_cust + 1)
-    _write_tbl(os.path.join(data_dir, "customer"), [
-        ckey,
-        np.char.add("Customer#", ckey.astype(str)),
-        np.char.add("Addr C", rng.integers(0, 10**6, n_cust).astype(str)),
-        rng.integers(0, 25, n_cust),
-        _phones(rng, n_cust),
-        _money(rng, n_cust, -999.99, 9999.99),
-        rng.choice(SEGMENTS, n_cust),
-        _comments(rng, n_cust),
-    ], num_parts)
+    # customer (chunked) ---------------------------------------------------
+    # chunk never exceeds a partition's share, so every partition file
+    # gets rows even at tiny scales
+    def _step(n):
+        return max(1, min(chunk_rows, -(-n // num_parts)))
 
-    # part -----------------------------------------------------------------
-    pkey = np.arange(1, n_part + 1)
-    ptype = np.char.add(
-        np.char.add(np.char.add(rng.choice(TYPE_S1, n_part), " "),
-                    np.char.add(rng.choice(TYPE_S2, n_part), " ")),
-        rng.choice(TYPE_S3, n_part),
-    )
-    retail = (90000 + (pkey % 20001) + 100 * (pkey % 1000)) / 100.0
-    _write_tbl(os.path.join(data_dir, "part"), [
-        pkey,
-        np.char.add(
-            np.char.add(rng.choice(COLORS, n_part), " "),
-            rng.choice(NOUNS, n_part),
-        ),
-        np.char.add("Manufacturer#", rng.integers(1, 6, n_part).astype(str)),
-        rng.choice(BRANDS, n_part),
-        ptype,
-        rng.integers(1, 51, n_part),
-        rng.choice(CONTAINERS, n_part),
-        retail,
-        _comments(rng, n_part),
-    ], num_parts)
+    cw = _TableWriter(os.path.join(data_dir, "customer"), num_parts)
+    for lo in range(0, n_cust, _step(n_cust)):
+        hi = min(lo + _step(n_cust), n_cust)
+        ckey = np.arange(lo + 1, hi + 1)
+        m = hi - lo
+        cw.write_chunk([
+            ckey,
+            np.char.add("Customer#", ckey.astype(str)),
+            np.char.add("Addr C", rng.integers(0, 10**6, m).astype(str)),
+            rng.integers(0, 25, m),
+            _phones(rng, m),
+            _money(rng, m, -999.99, 9999.99),
+            rng.choice(SEGMENTS, m),
+            _comments(rng, m),
+        ])
 
-    # partsupp (4 suppliers per part, dbgen layout) -------------------------
-    ps_pkey = np.repeat(pkey, 4)
-    ps_skey = ((ps_pkey - 1 + np.tile(np.arange(4), n_part) *
-                (n_supp // 4 + 1)) % n_supp) + 1
-    _write_tbl(os.path.join(data_dir, "partsupp"), [
-        ps_pkey, ps_skey,
-        rng.integers(1, 10000, n_psupp),
-        _money(rng, n_psupp, 1.00, 1000.00),
-        _comments(rng, n_psupp),
-    ], num_parts)
+    # part + partsupp (chunked together: partsupp derives from the part
+    # chunk's keys, 4 suppliers per part like dbgen) ------------------------
+    pw = _TableWriter(os.path.join(data_dir, "part"), num_parts)
+    psw = _TableWriter(os.path.join(data_dir, "partsupp"), num_parts)
+    for lo in range(0, n_part, _step(n_part)):
+        hi = min(lo + _step(n_part), n_part)
+        pkey = np.arange(lo + 1, hi + 1)
+        m = hi - lo
+        ptype = np.char.add(
+            np.char.add(np.char.add(rng.choice(TYPE_S1, m), " "),
+                        np.char.add(rng.choice(TYPE_S2, m), " ")),
+            rng.choice(TYPE_S3, m),
+        )
+        retail = (90000 + (pkey % 20001) + 100 * (pkey % 1000)) / 100.0
+        pw.write_chunk([
+            pkey,
+            np.char.add(
+                np.char.add(rng.choice(COLORS, m), " "),
+                rng.choice(NOUNS, m),
+            ),
+            np.char.add("Manufacturer#", rng.integers(1, 6, m).astype(str)),
+            rng.choice(BRANDS, m),
+            ptype,
+            rng.integers(1, 51, m),
+            rng.choice(CONTAINERS, m),
+            retail,
+            _comments(rng, m),
+        ])
+        ps_pkey = np.repeat(pkey, 4)
+        ps_skey = ((ps_pkey - 1 + np.tile(np.arange(4), m) *
+                    (n_supp // 4 + 1)) % n_supp) + 1
+        n_ps = 4 * m
+        psw.write_chunk([
+            ps_pkey, ps_skey,
+            rng.integers(1, 10000, n_ps),
+            _money(rng, n_ps, 1.00, 1000.00),
+            _comments(rng, n_ps),
+        ])
 
-    # orders ---------------------------------------------------------------
-    okey = np.arange(1, n_ord + 1) * 4 - 3  # sparse keys like dbgen
-    o_cust = rng.integers(1, n_cust + 1, n_ord)
-    span = int((END_ORDER - START) / np.timedelta64(1, "D"))
-    o_date = START + rng.integers(0, span, n_ord).astype("timedelta64[D]")
-    _write_tbl(os.path.join(data_dir, "orders"), [
-        okey, o_cust,
-        rng.choice(["O", "F", "P"], n_ord, p=[0.49, 0.49, 0.02]),
-        _money(rng, n_ord, 1000.0, 400000.0),
-        o_date,
-        rng.choice(PRIORITIES, n_ord),
-        np.char.add("Clerk#", rng.integers(1, 1000, n_ord).astype(str)),
-        np.zeros(n_ord, dtype=np.int64),
-        _comments(rng, n_ord),
-    ], num_parts)
-
-    # lineitem -------------------------------------------------------------
-    n_lines_per = rng.integers(1, 8, n_ord)
-    l_okey = np.repeat(okey, n_lines_per)
-    l_odate = np.repeat(o_date, n_lines_per)
-    n_li = len(l_okey)
-    l_pkey = rng.integers(1, n_part + 1, n_li)
-    l_skey = ((l_pkey - 1 + rng.integers(0, 4, n_li) * (n_supp // 4 + 1))
-              % n_supp) + 1
-    l_lnum = np.concatenate([np.arange(1, k + 1) for k in n_lines_per])
-    qty = rng.integers(1, 51, n_li)
-    retail_of = (90000 + (l_pkey % 20001) + 100 * (l_pkey % 1000)) / 100.0
-    eprice = np.round(qty * retail_of, 2)
-    disc = rng.integers(0, 11, n_li) / 100.0
-    tax = rng.integers(0, 9, n_li) / 100.0
-    sdate = l_odate + rng.integers(1, 122, n_li).astype("timedelta64[D]")
-    cdate = l_odate + rng.integers(30, 91, n_li).astype("timedelta64[D]")
-    rdate = sdate + rng.integers(1, 31, n_li).astype("timedelta64[D]")
-    returned = rdate <= CUTOFF
-    rflag = np.where(returned,
-                     np.where(rng.random(n_li) < 0.5, "R", "A"), "N")
-    lstatus = np.where(sdate > CUTOFF, "O", "F")
-    _write_tbl(os.path.join(data_dir, "lineitem"), [
-        l_okey, l_pkey, l_skey, l_lnum,
-        qty.astype(np.float64), eprice, disc, tax,
-        rflag, lstatus, sdate, cdate, rdate,
-        rng.choice(INSTRUCTIONS, n_li),
-        rng.choice(SHIPMODES, n_li),
-        _comments(rng, n_li),
-    ], num_parts)
+    # orders + lineitem (chunked together) ---------------------------------
+    ow = _TableWriter(os.path.join(data_dir, "orders"), num_parts)
+    lw = _TableWriter(os.path.join(data_dir, "lineitem"), num_parts)
+    for lo in range(0, n_ord, _step(n_ord)):
+        hi = min(lo + _step(n_ord), n_ord)
+        orders_cols, lineitem_cols = _gen_orders_chunk(
+            rng, lo, hi, n_cust, n_part, n_supp)
+        ow.write_chunk(orders_cols)
+        lw.write_chunk(lineitem_cols)
 
 
 if __name__ == "__main__":
@@ -227,6 +300,7 @@ if __name__ == "__main__":
     ap.add_argument("--out", required=True)
     ap.add_argument("--scale", type=float, default=0.01)
     ap.add_argument("--parts", type=int, default=2)
+    ap.add_argument("--chunk-rows", type=int, default=500_000)
     args = ap.parse_args()
-    generate(args.out, args.scale, args.parts)
+    generate(args.out, args.scale, args.parts, chunk_rows=args.chunk_rows)
     print(f"generated TPC-H-like data at scale {args.scale} in {args.out}")

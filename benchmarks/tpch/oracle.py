@@ -15,9 +15,13 @@ from .schema_def import TPCH_SCHEMAS
 _D = lambda s: np.datetime64(s, "D")
 
 
-def load_tables(data_dir: str) -> dict:
+def load_tables(data_dir: str, only=None) -> dict:
+    """``only``: subset of table names to load (large scale factors:
+    loading all 8 tables into pandas costs tens of GB of RAM)."""
     out = {}
     for name, sch in TPCH_SCHEMAS.items():
+        if only is not None and name not in only:
+            continue
         base = os.path.join(data_dir, name)
         files = (
             sorted(

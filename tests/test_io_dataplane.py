@@ -59,7 +59,7 @@ def test_data_plane_rejects_traversal_job_id(tmp_path):
                 "localhost", server.port, "../secret", 1, 0, shuffle_output=0
             )
     finally:
-        server.shutdown()
+        server.close()
 
 
 # ---------------------------------------------------------------------------
