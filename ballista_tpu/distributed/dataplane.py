@@ -179,15 +179,18 @@ class NativeDataPlane:
         cmd = [bin_path, str(port), work_dir]
         if bind_host:
             cmd.append(bind_host)
-        # The binary arms PR_SET_PDEATHSIG itself (shuffle_server.cpp
-        # main), so a SIGKILLed executor can't orphan a daemon wedging
-        # the configured port — and no preexec_fn is needed here (fork
+        # The binary ties its lifetime to THIS process (PDEATHSIG +
+        # getppid watch against SHUFFLE_SERVER_PARENT_PID), so a
+        # SIGKILLed executor can't orphan a daemon wedging the
+        # configured port — and no preexec_fn is needed here (fork
         # hooks deadlock under multithreaded jax).
+        env = dict(os.environ)
+        env["SHUFFLE_SERVER_PARENT_PID"] = str(os.getpid())
         self._proc = subprocess.Popen(
             cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
+            text=True, env=env,
         )
-        line = self._proc.stdout.readline()
+        line = self._read_banner(timeout_s=10.0)
         try:
             self.port = int(line.split("port")[1].split()[0])
         except (IndexError, ValueError):
@@ -196,6 +199,24 @@ class NativeDataPlane:
             raise IoError(
                 f"native shuffle server failed to start: {line!r}")
         self.work_dir = work_dir
+
+    def _read_banner(self, timeout_s: float) -> str:
+        """First stdout line with a deadline: a child that binds but
+        never prints must fall back to the Python server, not hang the
+        executor constructor."""
+        import select
+
+        fd = self._proc.stdout.fileno()
+        ready, _, _ = select.select([fd], [], [], timeout_s)
+        if not ready:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001 - escalate
+                self._proc.kill()
+            raise IoError(
+                f"native shuffle server silent for {timeout_s:.0f}s")
+        return self._proc.stdout.readline()
 
     def close(self):
         self._proc.terminate()

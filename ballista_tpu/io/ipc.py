@@ -41,9 +41,14 @@ def _arrow():
         # mimalloc (pyarrow's default pool) intermittently corrupts under
         # this engine's thread mix (see ballista_tpu/__init__.py). The env
         # selector set there is inert on builds without jemalloc, so
-        # verify at first use and degrade to the system allocator.
+        # verify at first use and degrade to the system allocator — but
+        # only when the pool choice was OURS: a user's explicit
+        # ARROW_DEFAULT_MEMORY_POOL always wins.
+        user_chose = ("ARROW_DEFAULT_MEMORY_POOL" in os.environ
+                      and not os.environ.get("_BALLISTA_SET_ARROW_POOL"))
         try:
-            if (pa.default_memory_pool().backend_name == "mimalloc"
+            if (not user_chose
+                    and pa.default_memory_pool().backend_name == "mimalloc"
                     and not os.environ.get("BALLISTA_ALLOW_MIMALLOC")):
                 pa.set_memory_pool(pa.system_memory_pool())
         except Exception:  # noqa: BLE001 - keep whatever pool exists

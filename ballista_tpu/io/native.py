@@ -92,6 +92,10 @@ def _load():
         lib.tbl_open_range.argtypes = lib.tbl_open.argtypes + [
             ctypes.c_int64, ctypes.c_int64,
         ]
+        lib.tbl_open_range_mt.restype = ctypes.c_void_p
+        lib.tbl_open_range_mt.argtypes = lib.tbl_open_range.argtypes + [
+            ctypes.c_int,
+        ]
         lib.tbl_error.restype = ctypes.c_char_p
         lib.tbl_error.argtypes = [ctypes.c_void_p]
         lib.tbl_num_rows.restype = ctypes.c_int64
@@ -137,6 +141,7 @@ def scan_file(
     skip_header: bool = False,
     offset: int = 0,
     max_bytes: int = -1,
+    threads: int = 0,
 ) -> Tuple[int, Dict[str, np.ndarray], Dict[str, np.ndarray],
            Dict[str, np.ndarray]]:
     """Parse one file (or a byte range of it) natively. Returns (num_rows,
@@ -147,7 +152,12 @@ def scan_file(
     Range semantics (offset/max_bytes): rows start at the first line
     boundary after ``offset`` and include every row beginning before
     ``offset + max_bytes``, so adjacent ranges partition the file's rows
-    exactly (bounded-RAM streaming / parallel chunk workers)."""
+    exactly (bounded-RAM streaming / parallel chunk workers).
+
+    ``threads``: parse the range with N parallel workers (sub-ranges
+    merged in order, utf8 codes remapped onto a union dictionary).
+    0 = auto: BALLISTA_SCAN_THREADS, else the host's CPU count. The
+    native side clamps so each worker gets >= 16MB."""
     lib = _load()
     if lib is None:
         raise IoError("native scanner not built")
@@ -159,9 +169,13 @@ def scan_file(
     widx = [schema.index_of(n) for n in wanted]
     wantarr = (ctypes.c_int32 * max(len(widx), 1))(*(widx or [0]))
 
-    h = lib.tbl_open_range(path.encode(), ncols, kinds, scales, wantarr,
-                           len(widx), delimiter.encode()[0:1],
-                           1 if skip_header else 0, offset, max_bytes)
+    if threads <= 0:
+        threads = int(os.environ.get("BALLISTA_SCAN_THREADS", 0) or
+                      (os.cpu_count() or 1))
+    h = lib.tbl_open_range_mt(path.encode(), ncols, kinds, scales, wantarr,
+                              len(widx), delimiter.encode()[0:1],
+                              1 if skip_header else 0, offset, max_bytes,
+                              threads)
     try:
         err = lib.tbl_error(h)
         if err:

@@ -28,9 +28,13 @@ from ..errors import IoError
 from ..logical import TableSource
 
 # Files larger than this stream through the native scanner in byte-range
-# chunks (bounded RAM at any scale factor) instead of one whole-file parse.
+# chunks (bounded RAM at any scale factor) instead of one whole-file
+# parse. Streaming pays one extra pre-pass over the file to build
+# table-wide utf8 dictionaries, so the threshold is set where whole-file
+# RAM actually hurts (~1GB of text -> a few GB resident), keeping
+# SF<=1-class files on the single-parse fast path.
 STREAM_CHUNK_BYTES = int(
-    os.environ.get("BALLISTA_SCAN_CHUNK_BYTES", str(256 << 20))
+    os.environ.get("BALLISTA_SCAN_CHUNK_BYTES", str(1 << 30))
 )
 
 

@@ -366,12 +366,19 @@ int main(int argc, char** argv) {
   printf("ballista-tpu shuffle server on port %d serving %s\n", port, argv[2]);
   fflush(stdout);
   if (tie_to_parent) {
-    // PDEATHSIG can be inert under some sandboxes/kernels, so also poll:
-    // reparenting (getppid changes) means the spawning executor is gone
-    const pid_t original_parent = getppid();
+    // PDEATHSIG can be inert under some sandboxes/kernels, so also poll.
+    // The EXPECTED parent pid comes from the spawner
+    // (SHUFFLE_SERVER_PARENT_PID): comparing against a pid captured
+    // here would race a parent that died before we got scheduled —
+    // we'd record the reaper and never notice. Reparenting (getppid
+    // differs from the expected pid, or init) means the executor died.
+    pid_t expected = getppid();
+    const char* pp = getenv("SHUFFLE_SERVER_PARENT_PID");
+    if (pp != nullptr && atoi(pp) > 0) expected = (pid_t)atoi(pp);
     for (;;) {
+      pid_t now = getppid();
+      if (now != expected || now == 1) return 0;
       sleep(2);
-      if (getppid() != original_parent) return 0;
     }
   }
   pause();

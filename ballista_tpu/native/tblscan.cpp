@@ -22,6 +22,7 @@
 #include <cstdio>
 #include <cstring>
 #include <fcntl.h>
+#include <pthread.h>
 #include <string>
 #include <sys/mman.h>
 #include <sys/stat.h>
@@ -193,6 +194,100 @@ inline bool parse_field(Column& c, const char* s, const char* e) {
   }
 }
 
+// Parse rows of [start-boundary after `from`, first row at/after `to`)
+// into t's columns. Returns false (with t->error set) on a parse error.
+// `data`/`end` bound the whole mapping; `from`==data means "begin at the
+// top" (header handling is the caller's job).
+bool parse_span(Table* t, const char* data, const char* end,
+                const char* from, const char* to, char delim, int ncols) {
+  const char* p = from;
+  if (from != data) {
+    // row ownership rule: a row belongs to the span containing its
+    // first byte (probe for the newline ending the previous row)
+    p = from - 1;
+    const char* nl = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    p = (nl == nullptr) ? end : nl + 1;
+  }
+  int64_t row = 0;
+  while (p < to) {  // a row that BEGINS before `to` parses to its EOL
+    const char* nl = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (nl == nullptr) nl = end;
+    if (p == nl) {  // empty line
+      ++p;
+      continue;
+    }
+    for (int ci = 0; ci < ncols; ++ci) {
+      const char* fe = static_cast<const char*>(
+          memchr(p, delim, static_cast<size_t>(nl - p)));
+      if (fe == nullptr) fe = nl;
+      Column& c = t->cols[static_cast<size_t>(ci)];
+      if (c.kind >= 0) {
+        if (!parse_field(c, p, fe)) {
+          char msg[160];
+          snprintf(msg, sizeof msg,
+                   "parse error at row %lld col %d (kind %d)",
+                   static_cast<long long>(row), ci, c.kind);
+          t->error = msg;
+          return false;
+        }
+        if (c.has_null) c.valid.resize(col_size(c), 1);
+      }
+      p = fe < nl ? fe + 1 : nl;  // consume field delimiter
+    }
+    p = nl < end ? nl + 1 : end;
+    ++row;
+  }
+  t->num_rows = row;
+  return true;
+}
+
+// Append src's parsed rows onto dst (same column layout). utf8 codes are
+// remapped into dst's dictionary space; validity lengths are normalized.
+void append_table(Table& dst, Table& src, int ncols) {
+  for (int ci = 0; ci < ncols; ++ci) {
+    Column& d = dst.cols[static_cast<size_t>(ci)];
+    Column& s = src.cols[static_cast<size_t>(ci)];
+    if (d.kind < 0) continue;
+    const size_t d_rows = col_size(d);
+    const size_t s_rows = col_size(s);
+    if (d.kind == 4) {
+      std::vector<int32_t> remap(s.dict_values.size());
+      for (size_t i = 0; i < s.dict_values.size(); ++i) {
+        auto it = d.dict_map.find(s.dict_values[i]);
+        if (it == d.dict_map.end()) {
+          int32_t code = static_cast<int32_t>(d.dict_values.size());
+          d.dict_map.emplace(s.dict_values[i], code);
+          d.dict_values.push_back(s.dict_values[i]);
+          remap[i] = code;
+        } else {
+          remap[i] = it->second;
+        }
+      }
+      d.i32.reserve(d.i32.size() + s.i32.size());
+      for (int32_t code : s.i32) d.i32.push_back(remap[code]);
+      // the 1-byte fast cache maps to dst codes already; leave it
+    } else {
+      d.i64.insert(d.i64.end(), s.i64.begin(), s.i64.end());
+      d.i32.insert(d.i32.end(), s.i32.begin(), s.i32.end());
+      d.f32.insert(d.f32.end(), s.f32.begin(), s.f32.end());
+    }
+    if (s.has_null && !d.has_null) {
+      d.valid.assign(d_rows, 1);
+      d.has_null = true;
+    }
+    if (d.has_null) {
+      if (s.has_null) {
+        d.valid.insert(d.valid.end(), s.valid.begin(), s.valid.end());
+      } else {
+        d.valid.insert(d.valid.end(), s_rows, 1);
+      }
+    }
+  }
+  dst.num_rows += src.num_rows;
+}
+
 void sort_dictionary(Column& c) {
   // sort dict; remap codes so they stay ordinal
   const size_t n = c.dict_values.size();
@@ -225,18 +320,23 @@ extern "C" {
 // starts at the first line boundary AFTER offset, and parsing runs to
 // the first line boundary at/after offset+max_bytes (max_bytes < 0 =
 // EOF). Adjacent ranges therefore partition the file's rows exactly.
-void* tbl_open_range(const char* path, int ncols, const int32_t* kinds,
-                     const int32_t* scales, const int32_t* wanted,
-                     int nwanted, char delimiter, int skip_header,
-                     int64_t offset, int64_t max_bytes) {
+void* tbl_open_range_mt(const char* path, int ncols, const int32_t* kinds,
+                        const int32_t* scales, const int32_t* wanted,
+                        int nwanted, char delimiter, int skip_header,
+                        int64_t offset, int64_t max_bytes, int nthreads) {
+  auto init_table = [&](Table* t) {
+    t->cols.resize(static_cast<size_t>(ncols));
+    std::vector<char> want(static_cast<size_t>(ncols), 0);
+    for (int i = 0; i < nwanted; ++i)
+      want[static_cast<size_t>(wanted[i])] = 1;
+    for (int i = 0; i < ncols; ++i) {
+      t->cols[static_cast<size_t>(i)].kind =
+          want[static_cast<size_t>(i)] ? kinds[i] : -1;
+      t->cols[static_cast<size_t>(i)].scale = scales[i];
+    }
+  };
   auto* t = new Table();
-  t->cols.resize(static_cast<size_t>(ncols));
-  std::vector<char> want(static_cast<size_t>(ncols), 0);
-  for (int i = 0; i < nwanted; ++i) want[static_cast<size_t>(wanted[i])] = 1;
-  for (int i = 0; i < ncols; ++i) {
-    t->cols[static_cast<size_t>(i)].kind = want[static_cast<size_t>(i)] ? kinds[i] : -1;
-    t->cols[static_cast<size_t>(i)].scale = scales[i];
-  }
+  init_table(t);
 
   int fd = open(path, O_RDONLY);
   if (fd < 0) {
@@ -257,68 +357,99 @@ void* tbl_open_range(const char* path, int ncols, const int32_t* kinds,
     t->error = std::string("mmap failed: ") + strerror(errno);
     return t;
   }
-
-  const char* p = data;
   const char* end = data + size;
-  if (offset > 0) {
-    // a row belongs to the range containing its FIRST byte: start at the
-    // first row whose start position is >= offset, i.e. just after the
-    // first newline at position >= offset-1 (a row starting exactly at
-    // `offset` has its preceding newline at offset-1 and is ours; a row
-    // straddling the boundary started earlier and belongs to the
-    // previous range, which parses rows it BEGINS to their full line)
-    p = data + (offset - 1);
-    const char* nl = static_cast<const char*>(
-        memchr(p, '\n', static_cast<size_t>(end - p)));
-    p = (nl == nullptr) ? end : nl + 1;
-  }
-  const char* stop = end;  // parse rows that BEGIN before stop
+  const char* from = data + offset;  // span rule handles row alignment
+  const char* stop = end;            // parse rows that BEGIN before stop
   if (max_bytes >= 0 && offset + max_bytes < static_cast<int64_t>(size)) {
     stop = data + offset + max_bytes;
   }
   if (skip_header && offset == 0) {
+    const char* p = data;
     while (p < end && *p != '\n') ++p;
-    if (p < end) ++p;
+    from = (p < end) ? p + 1 : end;
+    // the header consumed the span's data==from anchor; fake a non-top
+    // start so parse_span's boundary probe lands on the header's newline
+    if (from == end) stop = from;
   }
-  const char delim = delimiter;
-  int64_t row = 0;
-  while (p < stop) {  // a row that BEGINS before stop parses to its EOL
-    // line end first (SIMD memchr), so field scans are bounded by it and
-    // a malformed short line can never bleed into the next row
-    const char* nl = static_cast<const char*>(
-        memchr(p, '\n', static_cast<size_t>(end - p)));
-    if (nl == nullptr) nl = end;
-    if (p == nl) {  // empty line
-      ++p;
-      continue;
+
+  const int64_t span_bytes = stop - from;
+  int nt = nthreads;
+  if (nt < 1) nt = 1;
+  // a thread needs enough bytes to amortize merge cost (env override is
+  // for tests exercising the merge on small inputs)
+  int64_t min_per = 16 << 20;
+  const char* mp = getenv("TBLSCAN_MIN_THREAD_BYTES");
+  if (mp != nullptr && atoll(mp) > 0) min_per = atoll(mp);
+  if (span_bytes / min_per < nt)
+    nt = static_cast<int>(span_bytes / min_per);
+  if (nt < 1) nt = 1;
+
+  // offset==0 starts row-aligned (top of file, or just past the header),
+  // so parse_span's boundary probe is skipped by passing data==from;
+  // offset>0 must probe for the previous row's newline
+  const bool aligned = (offset == 0);
+  if (nt == 1) {
+    if (!parse_span(t, aligned ? from : data, end, from, stop, delimiter,
+                    ncols)) {
+      munmap(const_cast<char*>(data), size);
+      return t;
     }
-    for (int ci = 0; ci < ncols; ++ci) {
-      const char* fe = static_cast<const char*>(
-          memchr(p, delim, static_cast<size_t>(nl - p)));
-      if (fe == nullptr) fe = nl;
-      Column& c = t->cols[static_cast<size_t>(ci)];
-      if (c.kind >= 0) {
-        if (!parse_field(c, p, fe)) {
-          char msg[160];
-          snprintf(msg, sizeof msg,
-                   "parse error at row %lld col %d (kind %d)",
-                   static_cast<long long>(row), ci, c.kind);
-          t->error = msg;
-          munmap(const_cast<char*>(data), size);
-          return t;
-        }
-        if (c.has_null) c.valid.resize(col_size(c), 1);
+  } else {
+    std::vector<Table> parts(static_cast<size_t>(nt));
+    std::vector<pthread_t> threads(static_cast<size_t>(nt));
+    struct Job {
+      Table* t;
+      const char* data;
+      const char* end;
+      const char* from;
+      const char* to;
+      char delim;
+      int ncols;
+    };
+    std::vector<Job> jobs(static_cast<size_t>(nt));
+    const int64_t per = span_bytes / nt;
+    for (int i = 0; i < nt; ++i) {
+      auto& part = parts[static_cast<size_t>(i)];
+      init_table(&part);
+      const char* lo = from + per * i;
+      const char* hi = (i == nt - 1) ? stop : from + per * (i + 1);
+      // only an aligned first sub-span may skip the boundary probe
+      jobs[static_cast<size_t>(i)] = {
+          &part, (i == 0 && aligned) ? lo : data, end, lo, hi, delimiter,
+          ncols};
+    }
+    auto run = [](void* arg) -> void* {
+      auto* j = static_cast<Job*>(arg);
+      parse_span(j->t, j->data, j->end, j->from, j->to, j->delim, j->ncols);
+      return nullptr;
+    };
+    for (int i = 0; i < nt; ++i)
+      pthread_create(&threads[static_cast<size_t>(i)], nullptr, run,
+                     &jobs[static_cast<size_t>(i)]);
+    for (int i = 0; i < nt; ++i)
+      pthread_join(threads[static_cast<size_t>(i)], nullptr);
+    for (int i = 0; i < nt; ++i) {
+      if (!parts[static_cast<size_t>(i)].error.empty()) {
+        t->error = parts[static_cast<size_t>(i)].error;
+        munmap(const_cast<char*>(data), size);
+        return t;
       }
-      p = fe < nl ? fe + 1 : nl;  // consume field delimiter
     }
-    p = nl < end ? nl + 1 : end;
-    ++row;
+    for (int i = 0; i < nt; ++i)
+      append_table(*t, parts[static_cast<size_t>(i)], ncols);
   }
   munmap(const_cast<char*>(data), size);
-  t->num_rows = row;
   for (auto& c : t->cols)
     if (c.kind == 4) sort_dictionary(c);
   return t;
+}
+
+void* tbl_open_range(const char* path, int ncols, const int32_t* kinds,
+                     const int32_t* scales, const int32_t* wanted,
+                     int nwanted, char delimiter, int skip_header,
+                     int64_t offset, int64_t max_bytes) {
+  return tbl_open_range_mt(path, ncols, kinds, scales, wanted, nwanted,
+                           delimiter, skip_header, offset, max_bytes, 1);
 }
 
 void* tbl_open(const char* path, int ncols, const int32_t* kinds,

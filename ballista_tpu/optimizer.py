@@ -78,11 +78,18 @@ def _structural_key(e: ex.Expr) -> str:
 
 
 def factor_or(e: ex.Expr) -> List[ex.Expr]:
-    """(A and X) or (A and Y) -> [A, (X or Y)].
+    """(A and X) or (A and Y) -> [A, (X or Y)] — plus derived IN lists.
 
     Pulls conjuncts common to every OR branch to the top (matched by
     qualifier-aware structural key). TPC-H q19's OR-of-ANDs hides its join
     condition this way; factoring exposes it to the join-graph extractor.
+
+    Additionally derives IMPLIED per-column predicates: when every branch
+    pins the same column to literal(s) (``c = v`` / ``c IN (...)``), the
+    OR implies ``c IN (union)`` — a redundant-but-pushable conjunct. q7's
+    ``(n1=F AND n2=G) OR (n1=G AND n2=F)`` shares no common conjunct, yet
+    implies n1 IN (F,G) AND n2 IN (F,G), which pushdown sinks onto the
+    nation scans so the join pyramid above them shrinks by ~12x.
     """
     branches = split_disjuncts(e)
     if len(branches) < 2:
@@ -94,7 +101,7 @@ def factor_or(e: ex.Expr) -> List[ex.Expr]:
     for s in branch_sets[1:]:
         common_names &= set(s)
     if not common_names:
-        return [e]
+        return [e] + _derive_in_predicates(branches)
     out: List[ex.Expr] = [branch_sets[0][n] for n in sorted(common_names)]
     residuals = []
     for s in branch_sets:
@@ -108,7 +115,53 @@ def factor_or(e: ex.Expr) -> List[ex.Expr]:
     for r in residuals[1:]:
         ored = ex.BinaryExpr(ored, "or", r)
     out.append(ored)
+    # derive from the residuals only: the factored commons already pin
+    # their columns exactly
+    return out + _derive_in_predicates(residuals)
+
+
+def _branch_literal_constraints(branch: ex.Expr):
+    """column structural key -> (ColumnRef, literal values) for conjuncts
+    of the form ``col = lit`` / ``col IN (lits)``. None values = column
+    not literal-pinned in this branch."""
+    out = {}
+    for c in split_conjuncts(branch):
+        col = vals = None
+        if isinstance(c, ex.BinaryExpr) and c.op == "=":
+            if isinstance(c.left, ex.ColumnRef) and isinstance(
+                    c.right, ex.Literal):
+                col, vals = c.left, [c.right]
+            elif isinstance(c.right, ex.ColumnRef) and isinstance(
+                    c.left, ex.Literal):
+                col, vals = c.right, [c.left]
+        elif (isinstance(c, ex.InList) and not c.negated
+              and isinstance(c.expr, ex.ColumnRef)
+              and all(isinstance(v, ex.Literal) for v in c.list)):
+            col, vals = c.expr, list(c.list)
+        if col is not None:
+            key = _structural_key(col)
+            entry = out.setdefault(key, (col, []))
+            entry[1].extend(vals)
     return out
+
+
+def _derive_in_predicates(branches) -> List[ex.Expr]:
+    """Columns literal-pinned in EVERY branch -> implied IN conjuncts."""
+    maps = [_branch_literal_constraints(b) for b in branches]
+    keys = set(maps[0])
+    for m in maps[1:]:
+        keys &= set(m)
+    derived = []
+    for k in sorted(keys):
+        col = maps[0][k][0]
+        seen, lits = set(), []
+        for m in maps:
+            for lit in m[k][1]:
+                if lit.value not in seen:
+                    seen.add(lit.value)
+                    lits.append(lit)
+        derived.append(ex.InList(col, lits))
+    return derived
 
 
 def push_filters(plan: LogicalPlan) -> LogicalPlan:

@@ -418,6 +418,10 @@ class HashAggregateExec(PhysicalPlan):
             out, num_groups = fn(batch)
             ng = int(num_groups)
             if ng <= cap:
+                # persist the learned capacity: the operator instance is
+                # reused across partitions AND collects (plan cache), so
+                # later runs skip the undersized attempt + retry sync
+                self.group_capacity = max(self.group_capacity, cap)
                 return out
             cap = round_capacity(ng)
 

@@ -336,16 +336,23 @@ class JoinExec(PhysicalPlan):
             return
         from .base import maybe_compact
 
-        for pb in self.probe.execute(partition):
-            remaps = self._remaps_for(build_batch, pb)
-            if unique:
+        if unique:
+            for pb in self.probe.execute(partition):
+                remaps = self._remaps_for(build_batch, pb)
                 # selective joins strand few live rows in huge batches;
                 # compacting here shrinks every downstream operator
                 yield maybe_compact(self._probe_unique_batch(
                     table, build_batch, pb, mode, key_tables, remaps))
-            else:
-                yield from self._probe_expand_batch(table, build_batch, pb,
-                                                    mode, key_tables, remaps)
+        elif self.how in ("semi", "anti"):
+            # membership only: unique probe works regardless of build dups
+            for pb in self.probe.execute(partition):
+                remaps = self._remaps_for(build_batch, pb)
+                yield self._probe_unique_batch(table, build_batch, pb,
+                                               mode, key_tables, remaps)
+        else:
+            yield from self._probe_expand_stream(
+                table, build_batch, self.probe.execute(partition), mode,
+                key_tables)
 
     # full outer ------------------------------------------------------------
 
@@ -366,7 +373,7 @@ class JoinExec(PhysicalPlan):
                                                    mode, key_tables, remaps)
                 else:
                     yield from self._probe_expand_batch(
-                        table, build_batch, pb, mode, key_tables, remaps)
+                        table, build_batch, pb, mode, key_tables)
                 hit |= np.asarray(self._mark_hits(build_batch, pb, mode,
                                                   key_tables, remaps,
                                                   bkeys, blive))
@@ -527,65 +534,124 @@ class JoinExec(PhysicalPlan):
 
     # general path: expanding probe -----------------------------------------
 
-    def _probe_expand_batch(self, table, build_batch, pb: ColumnBatch,
-                            mode: str, key_tables,
-                            remaps) -> Iterator[ColumnBatch]:
-        if self.how not in ("inner", "left", "semi", "anti", "full"):
+    def _expand_run(self, table, build_batch, pb, mode, key_tables, remaps,
+                    out_cap: int):
+        """One async expanding-probe launch at a fixed output capacity.
+        Returns (out_batch, total_matches_device) WITHOUT syncing."""
+        key = ("e", mode, pb.capacity, build_batch.capacity, out_cap)
+        if key not in self._jit_probe:
+
+            def run(table, bb, pb, key_tables, remaps, _cap=out_cap):
+                pkeys, plive = self._probe_keys(pb, mode, key_tables,
+                                                remaps)
+                prows, brows, olive, total = join_k.probe_expand(
+                    table, pkeys, plive, _cap
+                )
+                out = self._assemble_expanded(bb, pb, prows, brows, olive)
+                return out, total
+
+            self._jit_probe[key] = jax.jit(run)
+        return self._jit_probe[key](table, build_batch, pb, key_tables,
+                                    remaps)
+
+    def _unmatched_batch(self, table, build_batch, pb, mode, key_tables,
+                         remaps) -> ColumnBatch:
+        """left/full: preserved probe rows with no match, null build
+        columns. Pure device work — no sync."""
+        key = ("l", mode, pb.capacity, build_batch.capacity)
+        if key not in self._jit_probe:
+
+            def run_unmatched(table, bb, pb, key_tables, remaps):
+                pkeys, plive = self._probe_keys(pb, mode, key_tables,
+                                                remaps)
+                counts = join_k.probe_counts(table, pkeys)
+                unmatched = jnp.logical_and(pb.selection,
+                                            jnp.logical_or(
+                                                jnp.logical_not(plive),
+                                                counts == 0))
+                zero = jnp.zeros((pb.capacity,), jnp.int32)
+                no_match = jnp.zeros((pb.capacity,), jnp.bool_)
+                return self._assemble(bb, pb, zero, no_match, unmatched,
+                                      None)
+
+            self._jit_probe[key] = jax.jit(run_unmatched)
+        return self._jit_probe[key](table, build_batch, pb, key_tables,
+                                    remaps)
+
+    def _probe_expand_batch(self, table, build_batch, pb, mode,
+                            key_tables) -> Iterator[ColumnBatch]:
+        """Single-batch expanding probe (full-outer accumulation needs
+        per-batch lockstep with its hit-marking pass)."""
+        yield from self._probe_expand_stream(table, build_batch, iter([pb]),
+                                             mode, key_tables)
+
+    def _probe_expand_stream(self, table, build_batch, probe_iter,
+                             mode: str, key_tables) -> Iterator[ColumnBatch]:
+        """Expanding probe over a batch stream with DEFERRED overflow
+        syncs: launches are asynchronous and match totals for a whole
+        window are fetched in ONE ``device_get`` (each blocking sync
+        costs ~80ms when the accelerator sits behind a tunnel — q5's
+        per-batch check was the dominant on-chip cost). Only overflowed
+        batches re-run; a learned capacity floor makes later windows
+        overflow-free."""
+        if self.how not in ("inner", "left", "full"):
             raise NotImplementedError_(
                 f"{self.how} join with duplicate build keys"
             )
-        if self.how in ("semi", "anti"):
-            # membership only: unique probe works regardless of build dups
-            yield self._probe_unique_batch(table, build_batch, pb,
-                                           mode, key_tables, remaps)
-            return
-        out_cap = pb.capacity
-        while True:
-            key = ("e", mode, pb.capacity, build_batch.capacity, out_cap)
-            if key not in self._jit_probe:
+        import os as _os
 
-                def run(table, bb, pb, key_tables, remaps, _cap=out_cap):
-                    pkeys, plive = self._probe_keys(pb, mode, key_tables,
-                                                    remaps)
-                    prows, brows, olive, total = join_k.probe_expand(
-                        table, pkeys, plive, _cap
-                    )
-                    out = self._assemble_expanded(bb, pb, prows, brows, olive)
-                    return out, total
-
-                self._jit_probe[key] = jax.jit(run)
-            out, total = self._jit_probe[key](table, build_batch, pb,
-                                              key_tables, remaps)
-            t = int(total)
-            if t <= out_cap:
-                break
-            out_cap = round_capacity(t)
         from .base import maybe_compact
 
-        # the overflow check above already synced the match count, so
-        # compaction here never costs an extra round-trip
-        yield maybe_compact(out, known_rows=min(t, out_cap))
-        if self.how in ("left", "full"):
-            # preserved probe rows with no match, null build columns
-            key = ("l", mode, pb.capacity, build_batch.capacity)
-            if key not in self._jit_probe:
+        window = max(int(_os.environ.get("BALLISTA_JOIN_SYNC_WINDOW", 8)), 1)
+        # the window also bounds BYTES pinned on device (probe + expanded
+        # output buffers stay live until their totals are fetched), so a
+        # wide join with huge batch capacities flushes early instead of
+        # multiplying its peak memory by the batch-count window
+        window_bytes = int(_os.environ.get(
+            "BALLISTA_JOIN_SYNC_WINDOW_BYTES", str(1 << 30)))
+        row_bytes = sum(
+            f.dtype.device_dtype().itemsize
+            for f in self.output_schema().fields
+        ) + sum(f.dtype.device_dtype().itemsize
+                for f in self.probe.output_schema().fields)
+        pend: list = []
+        pend_bytes = 0
 
-                def run_unmatched(table, bb, pb, key_tables, remaps):
-                    pkeys, plive = self._probe_keys(pb, mode, key_tables,
-                                                    remaps)
-                    counts = join_k.probe_counts(table, pkeys)
-                    unmatched = jnp.logical_and(pb.selection,
-                                                jnp.logical_or(
-                                                    jnp.logical_not(plive),
-                                                    counts == 0))
-                    zero = jnp.zeros((pb.capacity,), jnp.int32)
-                    no_match = jnp.zeros((pb.capacity,), jnp.bool_)
-                    return self._assemble(bb, pb, zero, no_match, unmatched,
-                                          None)
+        def flush():
+            nonlocal pend_bytes
+            pend_bytes = 0
+            if not pend:
+                return
+            totals = jax.device_get([p[-1] for p in pend])  # ONE sync
+            for (pb, remaps, out, out_cap, _), total in zip(pend, totals):
+                t = int(total)
+                while t > out_cap:  # rare: re-run at the exact capacity
+                    out_cap = round_capacity(t)
+                    out, tot = self._expand_run(
+                        table, build_batch, pb, mode, key_tables, remaps,
+                        out_cap)
+                    t = int(tot)
+                    self._expand_cap_floor = max(
+                        getattr(self, "_expand_cap_floor", 0), out_cap)
+                # the overflow check above already synced the match
+                # count, so compaction never costs an extra round-trip
+                yield maybe_compact(out, known_rows=min(t, out_cap))
+                if self.how in ("left", "full"):
+                    yield self._unmatched_batch(table, build_batch, pb,
+                                                mode, key_tables, remaps)
+            pend.clear()
 
-                self._jit_probe[key] = jax.jit(run_unmatched)
-            yield self._jit_probe[key](table, build_batch, pb, key_tables,
-                                       remaps)
+        for pb in probe_iter:
+            remaps = self._remaps_for(build_batch, pb)
+            out_cap = max(pb.capacity,
+                          getattr(self, "_expand_cap_floor", 0))
+            out, total = self._expand_run(table, build_batch, pb, mode,
+                                          key_tables, remaps, out_cap)
+            pend.append((pb, remaps, out, out_cap, total))
+            pend_bytes += (pb.capacity + out_cap) * row_bytes
+            if len(pend) >= window or pend_bytes >= window_bytes:
+                yield from flush()
+        yield from flush()
 
     # assembly --------------------------------------------------------------
 

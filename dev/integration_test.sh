@@ -11,6 +11,16 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 make -C ballista_tpu/native
+
+# Real-etcd tier: when an etcd binary (or BALLISTA_ETCD_URL) is present —
+# e.g. inside deploy/docker-compose.etcd.yaml — tests/test_real_etcd.py
+# runs the etcd v3 wire implementation against the real server instead of
+# only the in-repo fake (protocol-skew guard). It self-skips otherwise.
+if command -v etcd >/dev/null 2>&1 || [[ -n "${BALLISTA_ETCD_URL:-}" ]]; then
+  echo "real etcd detected: running protocol-skew tier"
+  python -m pytest tests/test_real_etcd.py -q
+fi
+
 if [[ "${FAST_ONLY:-0}" == "1" ]]; then
   python -m pytest tests/ -q -m "not sf02"
 else

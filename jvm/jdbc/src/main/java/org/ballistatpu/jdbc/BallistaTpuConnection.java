@@ -1,0 +1,317 @@
+/*
+ * One Flight channel per JDBC connection. Only the surface a BI tool's
+ * read path needs is implemented; everything transactional is a clean
+ * SQLFeatureNotSupportedException (the engine is a query engine).
+ */
+package org.ballistatpu.jdbc;
+
+import org.apache.arrow.flight.FlightClient;
+import org.apache.arrow.flight.Location;
+import org.apache.arrow.memory.BufferAllocator;
+import org.apache.arrow.memory.RootAllocator;
+
+import java.sql.Connection;
+import java.sql.DatabaseMetaData;
+import java.sql.PreparedStatement;
+import java.sql.SQLException;
+import java.sql.SQLFeatureNotSupportedException;
+import java.sql.Statement;
+
+public final class BallistaTpuConnection implements Connection {
+    private final BufferAllocator allocator;
+    private final FlightClient client;
+    private boolean closed;
+
+    BallistaTpuConnection(String host, int port) {
+        this.allocator = new RootAllocator(Long.MAX_VALUE);
+        this.client = FlightClient.builder(
+                allocator, Location.forGrpcInsecure(host, port)).build();
+    }
+
+    FlightClient flightClient() {
+        return client;
+    }
+
+    BufferAllocator allocator() {
+        return allocator;
+    }
+
+    @Override
+    public Statement createStatement() {
+        return new BallistaTpuStatement(this);
+    }
+
+    @Override
+    public void close() throws SQLException {
+        if (closed) {
+            return;
+        }
+        closed = true;
+        try {
+            client.close();
+            allocator.close();
+        } catch (InterruptedException e) {
+            Thread.currentThread().interrupt();
+            throw new SQLException("interrupted closing Flight channel", e);
+        }
+    }
+
+    @Override
+    public boolean isClosed() {
+        return closed;
+    }
+
+    @Override
+    public boolean isValid(int timeout) {
+        return !closed;
+    }
+
+    // -- read-only query engine: the rest is boilerplate ------------------
+
+    @Override
+    public PreparedStatement prepareStatement(String sql) throws SQLException {
+        throw new SQLFeatureNotSupportedException("prepared statements");
+    }
+
+    @Override
+    public java.sql.CallableStatement prepareCall(String sql) throws SQLException {
+        throw new SQLFeatureNotSupportedException("callable statements");
+    }
+
+    @Override
+    public String nativeSQL(String sql) {
+        return sql;
+    }
+
+    @Override
+    public void setAutoCommit(boolean autoCommit) {
+    }
+
+    @Override
+    public boolean getAutoCommit() {
+        return true;
+    }
+
+    @Override
+    public void commit() {
+    }
+
+    @Override
+    public void rollback() {
+    }
+
+    @Override
+    public DatabaseMetaData getMetaData() throws SQLException {
+        throw new SQLFeatureNotSupportedException("metadata");
+    }
+
+    @Override
+    public void setReadOnly(boolean readOnly) {
+    }
+
+    @Override
+    public boolean isReadOnly() {
+        return true;
+    }
+
+    @Override
+    public void setCatalog(String catalog) {
+    }
+
+    @Override
+    public String getCatalog() {
+        return "";
+    }
+
+    @Override
+    public void setTransactionIsolation(int level) {
+    }
+
+    @Override
+    public int getTransactionIsolation() {
+        return TRANSACTION_NONE;
+    }
+
+    @Override
+    public java.sql.SQLWarning getWarnings() {
+        return null;
+    }
+
+    @Override
+    public void clearWarnings() {
+    }
+
+    @Override
+    public Statement createStatement(int resultSetType, int resultSetConcurrency) {
+        return new BallistaTpuStatement(this);
+    }
+
+    @Override
+    public PreparedStatement prepareStatement(String sql, int t, int c) throws SQLException {
+        throw new SQLFeatureNotSupportedException("prepared statements");
+    }
+
+    @Override
+    public java.sql.CallableStatement prepareCall(String sql, int t, int c) throws SQLException {
+        throw new SQLFeatureNotSupportedException("callable statements");
+    }
+
+    @Override
+    public java.util.Map<String, Class<?>> getTypeMap() {
+        return java.util.Collections.emptyMap();
+    }
+
+    @Override
+    public void setTypeMap(java.util.Map<String, Class<?>> map) {
+    }
+
+    @Override
+    public void setHoldability(int holdability) {
+    }
+
+    @Override
+    public int getHoldability() {
+        return java.sql.ResultSet.CLOSE_CURSORS_AT_COMMIT;
+    }
+
+    @Override
+    public java.sql.Savepoint setSavepoint() throws SQLException {
+        throw new SQLFeatureNotSupportedException("savepoints");
+    }
+
+    @Override
+    public java.sql.Savepoint setSavepoint(String name) throws SQLException {
+        throw new SQLFeatureNotSupportedException("savepoints");
+    }
+
+    @Override
+    public void rollback(java.sql.Savepoint savepoint) throws SQLException {
+        throw new SQLFeatureNotSupportedException("savepoints");
+    }
+
+    @Override
+    public void releaseSavepoint(java.sql.Savepoint savepoint) throws SQLException {
+        throw new SQLFeatureNotSupportedException("savepoints");
+    }
+
+    @Override
+    public Statement createStatement(int t, int c, int h) {
+        return new BallistaTpuStatement(this);
+    }
+
+    @Override
+    public PreparedStatement prepareStatement(String sql, int t, int c, int h)
+            throws SQLException {
+        throw new SQLFeatureNotSupportedException("prepared statements");
+    }
+
+    @Override
+    public java.sql.CallableStatement prepareCall(String sql, int t, int c, int h)
+            throws SQLException {
+        throw new SQLFeatureNotSupportedException("callable statements");
+    }
+
+    @Override
+    public PreparedStatement prepareStatement(String sql, int autoGeneratedKeys)
+            throws SQLException {
+        throw new SQLFeatureNotSupportedException("prepared statements");
+    }
+
+    @Override
+    public PreparedStatement prepareStatement(String sql, int[] columnIndexes)
+            throws SQLException {
+        throw new SQLFeatureNotSupportedException("prepared statements");
+    }
+
+    @Override
+    public PreparedStatement prepareStatement(String sql, String[] columnNames)
+            throws SQLException {
+        throw new SQLFeatureNotSupportedException("prepared statements");
+    }
+
+    @Override
+    public java.sql.Clob createClob() throws SQLException {
+        throw new SQLFeatureNotSupportedException("clob");
+    }
+
+    @Override
+    public java.sql.Blob createBlob() throws SQLException {
+        throw new SQLFeatureNotSupportedException("blob");
+    }
+
+    @Override
+    public java.sql.NClob createNClob() throws SQLException {
+        throw new SQLFeatureNotSupportedException("nclob");
+    }
+
+    @Override
+    public java.sql.SQLXML createSQLXML() throws SQLException {
+        throw new SQLFeatureNotSupportedException("sqlxml");
+    }
+
+    @Override
+    public void setClientInfo(String name, String value) {
+    }
+
+    @Override
+    public void setClientInfo(java.util.Properties properties) {
+    }
+
+    @Override
+    public String getClientInfo(String name) {
+        return null;
+    }
+
+    @Override
+    public java.util.Properties getClientInfo() {
+        return new java.util.Properties();
+    }
+
+    @Override
+    public java.sql.Array createArrayOf(String typeName, Object[] elements)
+            throws SQLException {
+        throw new SQLFeatureNotSupportedException("arrays");
+    }
+
+    @Override
+    public java.sql.Struct createStruct(String typeName, Object[] attributes)
+            throws SQLException {
+        throw new SQLFeatureNotSupportedException("structs");
+    }
+
+    @Override
+    public void setSchema(String schema) {
+    }
+
+    @Override
+    public String getSchema() {
+        return "";
+    }
+
+    @Override
+    public void abort(java.util.concurrent.Executor executor) throws SQLException {
+        close();
+    }
+
+    @Override
+    public void setNetworkTimeout(java.util.concurrent.Executor executor, int ms) {
+    }
+
+    @Override
+    public int getNetworkTimeout() {
+        return 0;
+    }
+
+    @Override
+    public <T> T unwrap(Class<T> iface) throws SQLException {
+        if (iface.isInstance(this)) {
+            return iface.cast(this);
+        }
+        throw new SQLException("not a wrapper for " + iface);
+    }
+
+    @Override
+    public boolean isWrapperFor(Class<?> iface) {
+        return iface.isInstance(this);
+    }
+}

@@ -1,0 +1,86 @@
+/*
+ * Type-4 JDBC driver for ballista-tpu.
+ *
+ * URL format: jdbc:ballista-tpu://HOST:PORT
+ *
+ * The wire contract is Arrow Flight: executeQuery sends the raw SQL
+ * bytes as the Ticket of a DoGet and reads the schema-first record-batch
+ * stream back (server side: ballista_tpu/distributed/flight.py; the
+ * byte exchange is pinned by tests/test_flight.py with a stock pyarrow
+ * Flight client, so this driver and that test speak the same protocol).
+ */
+package org.ballistatpu.jdbc;
+
+import java.sql.Connection;
+import java.sql.Driver;
+import java.sql.DriverManager;
+import java.sql.DriverPropertyInfo;
+import java.sql.SQLException;
+import java.util.Properties;
+import java.util.logging.Logger;
+
+public final class BallistaTpuDriver implements Driver {
+    static final String URL_PREFIX = "jdbc:ballista-tpu://";
+
+    static {
+        try {
+            DriverManager.registerDriver(new BallistaTpuDriver());
+        } catch (SQLException e) {
+            throw new ExceptionInInitializerError(e);
+        }
+    }
+
+    @Override
+    public Connection connect(String url, Properties info) throws SQLException {
+        if (!acceptsURL(url)) {
+            return null; // per JDBC spec: not ours
+        }
+        String hostPort = url.substring(URL_PREFIX.length());
+        int slash = hostPort.indexOf('/');
+        if (slash >= 0) {
+            hostPort = hostPort.substring(0, slash);
+        }
+        int colon = hostPort.lastIndexOf(':');
+        if (colon <= 0) {
+            throw new SQLException("URL must be " + URL_PREFIX + "HOST:PORT");
+        }
+        String host = hostPort.substring(0, colon);
+        int port;
+        try {
+            port = Integer.parseInt(hostPort.substring(colon + 1));
+        } catch (NumberFormatException e) {
+            throw new SQLException("bad port in URL: " + url, e);
+        }
+        return new BallistaTpuConnection(host, port);
+    }
+
+    @Override
+    public boolean acceptsURL(String url) {
+        return url != null && url.startsWith(URL_PREFIX);
+    }
+
+    @Override
+    public DriverPropertyInfo[] getPropertyInfo(String url, Properties info) {
+        return new DriverPropertyInfo[0];
+    }
+
+    @Override
+    public int getMajorVersion() {
+        return 0;
+    }
+
+    @Override
+    public int getMinorVersion() {
+        return 2;
+    }
+
+    @Override
+    public boolean jdbcCompliant() {
+        return false;
+    }
+
+    @Override
+    public Logger getParentLogger() {
+        return Logger.getLogger("org.ballistatpu.jdbc");
+    }
+}

@@ -1,0 +1,1087 @@
+/*
+ * Forward-only cursor over a FlightStream: each Arrow record batch is a
+ * window of rows; next() walks rows then advances the stream. Column
+ * access covers the engine's result types (int64, float64, utf8, date32,
+ * timestamp, bool) via Arrow's FieldReader, so no per-type vector
+ * casting is needed here.
+ */
+package org.ballistatpu.jdbc;
+
+import org.apache.arrow.flight.FlightStream;
+import org.apache.arrow.vector.VectorSchemaRoot;
+import org.apache.arrow.vector.complex.reader.FieldReader;
+
+import java.math.BigDecimal;
+import java.sql.Date;
+import java.sql.ResultSet;
+import java.sql.ResultSetMetaData;
+import java.sql.SQLException;
+import java.sql.SQLFeatureNotSupportedException;
+import java.sql.Statement;
+import java.sql.Timestamp;
+
+public final class BallistaTpuResultSet implements ResultSet {
+    private final BallistaTpuStatement statement;
+    private final FlightStream stream;
+    private VectorSchemaRoot root;
+    private int rowInBatch = -1;
+    private boolean closed;
+    private boolean lastWasNull;
+
+    BallistaTpuResultSet(BallistaTpuStatement statement, FlightStream stream) {
+        this.statement = statement;
+        this.stream = stream;
+    }
+
+    @Override
+    public boolean next() throws SQLException {
+        checkOpen();
+        while (true) {
+            if (root != null && rowInBatch + 1 < root.getRowCount()) {
+                rowInBatch++;
+                return true;
+            }
+            if (!stream.next()) {
+                return false;
+            }
+            root = stream.getRoot();
+            rowInBatch = -1;
+        }
+    }
+
+    private FieldReader reader(int columnIndex) throws SQLException {
+        checkOpen();
+        if (root == null) {
+            throw new SQLException("call next() first");
+        }
+        if (columnIndex < 1 || columnIndex > root.getFieldVectors().size()) {
+            throw new SQLException("bad column index " + columnIndex);
+        }
+        FieldReader r = root.getVector(columnIndex - 1).getReader();
+        r.setPosition(rowInBatch);
+        lastWasNull = !r.isSet();
+        return r;
+    }
+
+    @Override
+    public int findColumn(String columnLabel) throws SQLException {
+        checkOpen();
+        if (root == null) {
+            throw new SQLException("call next() first");
+        }
+        var fields = root.getSchema().getFields();
+        for (int i = 0; i < fields.size(); i++) {
+            if (fields.get(i).getName().equalsIgnoreCase(columnLabel)) {
+                return i + 1;
+            }
+        }
+        throw new SQLException("no such column: " + columnLabel);
+    }
+
+    @Override
+    public boolean wasNull() {
+        return lastWasNull;
+    }
+
+    @Override
+    public String getString(int columnIndex) throws SQLException {
+        Object v = getObject(columnIndex);
+        return v == null ? null : v.toString();
+    }
+
+    @Override
+    public long getLong(int columnIndex) throws SQLException {
+        Object v = reader(columnIndex).readObject();
+        if (v == null) {
+            return 0;
+        }
+        return ((Number) v).longValue();
+    }
+
+    @Override
+    public int getInt(int columnIndex) throws SQLException {
+        return (int) getLong(columnIndex);
+    }
+
+    @Override
+    public double getDouble(int columnIndex) throws SQLException {
+        Object v = reader(columnIndex).readObject();
+        if (v == null) {
+            return 0.0;
+        }
+        return ((Number) v).doubleValue();
+    }
+
+    @Override
+    public float getFloat(int columnIndex) throws SQLException {
+        return (float) getDouble(columnIndex);
+    }
+
+    @Override
+    public boolean getBoolean(int columnIndex) throws SQLException {
+        Object v = reader(columnIndex).readObject();
+        return v != null && (v instanceof Boolean ? (Boolean) v
+                : ((Number) v).longValue() != 0);
+    }
+
+    @Override
+    public BigDecimal getBigDecimal(int columnIndex) throws SQLException {
+        Object v = reader(columnIndex).readObject();
+        if (v == null) {
+            return null;
+        }
+        if (v instanceof BigDecimal) {
+            return (BigDecimal) v;
+        }
+        return new BigDecimal(v.toString());
+    }
+
+    @Override
+    public Date getDate(int columnIndex) throws SQLException {
+        Object v = reader(columnIndex).readObject();
+        if (v == null) {
+            return null;
+        }
+        if (v instanceof java.time.LocalDate) {
+            return Date.valueOf((java.time.LocalDate) v);
+        }
+        if (v instanceof Number) { // date32: days since epoch
+            return new Date(((Number) v).longValue() * 86_400_000L);
+        }
+        return Date.valueOf(v.toString());
+    }
+
+    @Override
+    public Timestamp getTimestamp(int columnIndex) throws SQLException {
+        Object v = reader(columnIndex).readObject();
+        if (v == null) {
+            return null;
+        }
+        if (v instanceof java.time.LocalDateTime) {
+            return Timestamp.valueOf((java.time.LocalDateTime) v);
+        }
+        return Timestamp.valueOf(v.toString());
+    }
+
+    @Override
+    public Object getObject(int columnIndex) throws SQLException {
+        Object v = reader(columnIndex).readObject();
+        return v == null ? null : (v instanceof org.apache.arrow.vector.util.Text
+                ? v.toString() : v);
+    }
+
+    @Override
+    public String getString(String columnLabel) throws SQLException {
+        return getString(findColumn(columnLabel));
+    }
+
+    @Override
+    public long getLong(String columnLabel) throws SQLException {
+        return getLong(findColumn(columnLabel));
+    }
+
+    @Override
+    public int getInt(String columnLabel) throws SQLException {
+        return getInt(findColumn(columnLabel));
+    }
+
+    @Override
+    public double getDouble(String columnLabel) throws SQLException {
+        return getDouble(findColumn(columnLabel));
+    }
+
+    @Override
+    public float getFloat(String columnLabel) throws SQLException {
+        return getFloat(findColumn(columnLabel));
+    }
+
+    @Override
+    public boolean getBoolean(String columnLabel) throws SQLException {
+        return getBoolean(findColumn(columnLabel));
+    }
+
+    @Override
+    public BigDecimal getBigDecimal(String columnLabel) throws SQLException {
+        return getBigDecimal(findColumn(columnLabel));
+    }
+
+    @Override
+    public Date getDate(String columnLabel) throws SQLException {
+        return getDate(findColumn(columnLabel));
+    }
+
+    @Override
+    public Timestamp getTimestamp(String columnLabel) throws SQLException {
+        return getTimestamp(findColumn(columnLabel));
+    }
+
+    @Override
+    public Object getObject(String columnLabel) throws SQLException {
+        return getObject(findColumn(columnLabel));
+    }
+
+    @Override
+    public void close() throws SQLException {
+        if (closed) {
+            return;
+        }
+        closed = true;
+        try {
+            stream.close();
+        } catch (Exception e) {
+            throw new SQLException("closing flight stream", e);
+        }
+    }
+
+    @Override
+    public boolean isClosed() {
+        return closed;
+    }
+
+    @Override
+    public Statement getStatement() {
+        return statement;
+    }
+
+    @Override
+    public ResultSetMetaData getMetaData() throws SQLException {
+        throw new SQLFeatureNotSupportedException("metadata");
+    }
+
+    private void checkOpen() throws SQLException {
+        if (closed) {
+            throw new SQLException("result set is closed");
+        }
+    }
+
+    // -- unsupported JDBC surface ------------------------------------------
+
+    private static SQLException unsupported(String what) {
+        return new SQLFeatureNotSupportedException(what);
+    }
+
+    @Override
+    public byte getByte(int i) throws SQLException {
+        return (byte) getLong(i);
+    }
+
+    @Override
+    public short getShort(int i) throws SQLException {
+        return (short) getLong(i);
+    }
+
+    @Override
+    public byte[] getBytes(int i) throws SQLException {
+        throw unsupported("bytes");
+    }
+
+    @Override
+    public java.sql.Time getTime(int i) throws SQLException {
+        throw unsupported("time");
+    }
+
+    @Override
+    public java.io.InputStream getAsciiStream(int i) throws SQLException {
+        throw unsupported("streams");
+    }
+
+    @Override
+    @Deprecated
+    public java.io.InputStream getUnicodeStream(int i) throws SQLException {
+        throw unsupported("streams");
+    }
+
+    @Override
+    public java.io.InputStream getBinaryStream(int i) throws SQLException {
+        throw unsupported("streams");
+    }
+
+    @Override
+    public byte getByte(String l) throws SQLException {
+        return getByte(findColumn(l));
+    }
+
+    @Override
+    public short getShort(String l) throws SQLException {
+        return getShort(findColumn(l));
+    }
+
+    @Override
+    public byte[] getBytes(String l) throws SQLException {
+        throw unsupported("bytes");
+    }
+
+    @Override
+    public java.sql.Time getTime(String l) throws SQLException {
+        throw unsupported("time");
+    }
+
+    @Override
+    public java.io.InputStream getAsciiStream(String l) throws SQLException {
+        throw unsupported("streams");
+    }
+
+    @Override
+    @Deprecated
+    public java.io.InputStream getUnicodeStream(String l) throws SQLException {
+        throw unsupported("streams");
+    }
+
+    @Override
+    public java.io.InputStream getBinaryStream(String l) throws SQLException {
+        throw unsupported("streams");
+    }
+
+    @Override
+    public java.sql.SQLWarning getWarnings() {
+        return null;
+    }
+
+    @Override
+    public void clearWarnings() {
+    }
+
+    @Override
+    public String getCursorName() throws SQLException {
+        throw unsupported("cursor name");
+    }
+
+    @Override
+    @Deprecated
+    public BigDecimal getBigDecimal(int i, int scale) throws SQLException {
+        return getBigDecimal(i);
+    }
+
+    @Override
+    @Deprecated
+    public BigDecimal getBigDecimal(String l, int scale) throws SQLException {
+        return getBigDecimal(l);
+    }
+
+    @Override
+    public boolean isBeforeFirst() throws SQLException {
+        throw unsupported("scrolling");
+    }
+
+    @Override
+    public boolean isAfterLast() throws SQLException {
+        throw unsupported("scrolling");
+    }
+
+    @Override
+    public boolean isFirst() throws SQLException {
+        throw unsupported("scrolling");
+    }
+
+    @Override
+    public boolean isLast() throws SQLException {
+        throw unsupported("scrolling");
+    }
+
+    @Override
+    public void beforeFirst() throws SQLException {
+        throw unsupported("scrolling");
+    }
+
+    @Override
+    public void afterLast() throws SQLException {
+        throw unsupported("scrolling");
+    }
+
+    @Override
+    public boolean first() throws SQLException {
+        throw unsupported("scrolling");
+    }
+
+    @Override
+    public boolean last() throws SQLException {
+        throw unsupported("scrolling");
+    }
+
+    @Override
+    public int getRow() {
+        return 0;
+    }
+
+    @Override
+    public boolean absolute(int row) throws SQLException {
+        throw unsupported("scrolling");
+    }
+
+    @Override
+    public boolean relative(int rows) throws SQLException {
+        throw unsupported("scrolling");
+    }
+
+    @Override
+    public boolean previous() throws SQLException {
+        throw unsupported("scrolling");
+    }
+
+    @Override
+    public void setFetchDirection(int direction) {
+    }
+
+    @Override
+    public int getFetchDirection() {
+        return FETCH_FORWARD;
+    }
+
+    @Override
+    public void setFetchSize(int rows) {
+    }
+
+    @Override
+    public int getFetchSize() {
+        return 0;
+    }
+
+    @Override
+    public int getType() {
+        return TYPE_FORWARD_ONLY;
+    }
+
+    @Override
+    public int getConcurrency() {
+        return CONCUR_READ_ONLY;
+    }
+
+    @Override
+    public boolean rowUpdated() {
+        return false;
+    }
+
+    @Override
+    public boolean rowInserted() {
+        return false;
+    }
+
+    @Override
+    public boolean rowDeleted() {
+        return false;
+    }
+
+    // update surface: single consolidated refusal (read-only engine)
+    @Override
+    public void updateNull(int i) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBoolean(int i, boolean x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateByte(int i, byte x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateShort(int i, short x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateInt(int i, int x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateLong(int i, long x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateFloat(int i, float x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateDouble(int i, double x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBigDecimal(int i, BigDecimal x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateString(int i, String x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBytes(int i, byte[] x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateDate(int i, Date x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateTime(int i, java.sql.Time x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateTimestamp(int i, Timestamp x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateAsciiStream(int i, java.io.InputStream x, int l) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBinaryStream(int i, java.io.InputStream x, int l) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateCharacterStream(int i, java.io.Reader x, int l) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateObject(int i, Object x, int s) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateObject(int i, Object x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateNull(String l) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBoolean(String l, boolean x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateByte(String l, byte x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateShort(String l, short x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateInt(String l, int x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateLong(String l, long x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateFloat(String l, float x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateDouble(String l, double x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBigDecimal(String l, BigDecimal x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateString(String l, String x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBytes(String l, byte[] x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateDate(String l, Date x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateTime(String l, java.sql.Time x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateTimestamp(String l, Timestamp x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateAsciiStream(String l, java.io.InputStream x, int n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBinaryStream(String l, java.io.InputStream x, int n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateCharacterStream(String l, java.io.Reader r, int n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateObject(String l, Object x, int s) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateObject(String l, Object x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void insertRow() throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateRow() throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void deleteRow() throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void refreshRow() throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void cancelRowUpdates() throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void moveToInsertRow() throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void moveToCurrentRow() throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public Object getObject(int i, java.util.Map<String, Class<?>> map) throws SQLException {
+        return getObject(i);
+    }
+
+    @Override
+    public java.sql.Ref getRef(int i) throws SQLException {
+        throw unsupported("ref");
+    }
+
+    @Override
+    public java.sql.Blob getBlob(int i) throws SQLException {
+        throw unsupported("blob");
+    }
+
+    @Override
+    public java.sql.Clob getClob(int i) throws SQLException {
+        throw unsupported("clob");
+    }
+
+    @Override
+    public java.sql.Array getArray(int i) throws SQLException {
+        throw unsupported("array");
+    }
+
+    @Override
+    public Object getObject(String l, java.util.Map<String, Class<?>> map) throws SQLException {
+        return getObject(l);
+    }
+
+    @Override
+    public java.sql.Ref getRef(String l) throws SQLException {
+        throw unsupported("ref");
+    }
+
+    @Override
+    public java.sql.Blob getBlob(String l) throws SQLException {
+        throw unsupported("blob");
+    }
+
+    @Override
+    public java.sql.Clob getClob(String l) throws SQLException {
+        throw unsupported("clob");
+    }
+
+    @Override
+    public java.sql.Array getArray(String l) throws SQLException {
+        throw unsupported("array");
+    }
+
+    @Override
+    public Date getDate(int i, java.util.Calendar cal) throws SQLException {
+        return getDate(i);
+    }
+
+    @Override
+    public Date getDate(String l, java.util.Calendar cal) throws SQLException {
+        return getDate(l);
+    }
+
+    @Override
+    public java.sql.Time getTime(int i, java.util.Calendar cal) throws SQLException {
+        throw unsupported("time");
+    }
+
+    @Override
+    public java.sql.Time getTime(String l, java.util.Calendar cal) throws SQLException {
+        throw unsupported("time");
+    }
+
+    @Override
+    public Timestamp getTimestamp(int i, java.util.Calendar cal) throws SQLException {
+        return getTimestamp(i);
+    }
+
+    @Override
+    public Timestamp getTimestamp(String l, java.util.Calendar cal) throws SQLException {
+        return getTimestamp(l);
+    }
+
+    @Override
+    public java.net.URL getURL(int i) throws SQLException {
+        throw unsupported("url");
+    }
+
+    @Override
+    public java.net.URL getURL(String l) throws SQLException {
+        throw unsupported("url");
+    }
+
+    @Override
+    public void updateRef(int i, java.sql.Ref x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateRef(String l, java.sql.Ref x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBlob(int i, java.sql.Blob x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBlob(String l, java.sql.Blob x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateClob(int i, java.sql.Clob x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateClob(String l, java.sql.Clob x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateArray(int i, java.sql.Array x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateArray(String l, java.sql.Array x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public java.sql.RowId getRowId(int i) throws SQLException {
+        throw unsupported("rowid");
+    }
+
+    @Override
+    public java.sql.RowId getRowId(String l) throws SQLException {
+        throw unsupported("rowid");
+    }
+
+    @Override
+    public void updateRowId(int i, java.sql.RowId x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateRowId(String l, java.sql.RowId x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public int getHoldability() {
+        return CLOSE_CURSORS_AT_COMMIT;
+    }
+
+    @Override
+    public void updateNString(int i, String s) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateNString(String l, String s) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateNClob(int i, java.sql.NClob c) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateNClob(String l, java.sql.NClob c) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public java.sql.NClob getNClob(int i) throws SQLException {
+        throw unsupported("nclob");
+    }
+
+    @Override
+    public java.sql.NClob getNClob(String l) throws SQLException {
+        throw unsupported("nclob");
+    }
+
+    @Override
+    public java.sql.SQLXML getSQLXML(int i) throws SQLException {
+        throw unsupported("sqlxml");
+    }
+
+    @Override
+    public java.sql.SQLXML getSQLXML(String l) throws SQLException {
+        throw unsupported("sqlxml");
+    }
+
+    @Override
+    public void updateSQLXML(int i, java.sql.SQLXML x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateSQLXML(String l, java.sql.SQLXML x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public String getNString(int i) throws SQLException {
+        return getString(i);
+    }
+
+    @Override
+    public String getNString(String l) throws SQLException {
+        return getString(l);
+    }
+
+    @Override
+    public java.io.Reader getNCharacterStream(int i) throws SQLException {
+        throw unsupported("streams");
+    }
+
+    @Override
+    public java.io.Reader getNCharacterStream(String l) throws SQLException {
+        throw unsupported("streams");
+    }
+
+    @Override
+    public java.io.Reader getCharacterStream(int i) throws SQLException {
+        throw unsupported("streams");
+    }
+
+    @Override
+    public java.io.Reader getCharacterStream(String l) throws SQLException {
+        throw unsupported("streams");
+    }
+
+    @Override
+    public void updateNCharacterStream(int i, java.io.Reader r, long n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateNCharacterStream(String l, java.io.Reader r, long n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateAsciiStream(int i, java.io.InputStream x, long n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBinaryStream(int i, java.io.InputStream x, long n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateCharacterStream(int i, java.io.Reader r, long n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateAsciiStream(String l, java.io.InputStream x, long n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBinaryStream(String l, java.io.InputStream x, long n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateCharacterStream(String l, java.io.Reader r, long n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBlob(int i, java.io.InputStream s, long n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBlob(String l, java.io.InputStream s, long n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateClob(int i, java.io.Reader r, long n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateClob(String l, java.io.Reader r, long n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateNClob(int i, java.io.Reader r, long n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateNClob(String l, java.io.Reader r, long n) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateNCharacterStream(int i, java.io.Reader r) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateNCharacterStream(String l, java.io.Reader r) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateAsciiStream(int i, java.io.InputStream x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBinaryStream(int i, java.io.InputStream x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateCharacterStream(int i, java.io.Reader r) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateAsciiStream(String l, java.io.InputStream x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBinaryStream(String l, java.io.InputStream x) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateCharacterStream(String l, java.io.Reader r) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBlob(int i, java.io.InputStream s) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateBlob(String l, java.io.InputStream s) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateClob(int i, java.io.Reader r) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateClob(String l, java.io.Reader r) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateNClob(int i, java.io.Reader r) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public void updateNClob(String l, java.io.Reader r) throws SQLException {
+        throw unsupported("updates");
+    }
+
+    @Override
+    public <T> T getObject(int i, Class<T> type) throws SQLException {
+        return type.cast(getObject(i));
+    }
+
+    @Override
+    public <T> T getObject(String l, Class<T> type) throws SQLException {
+        return type.cast(getObject(l));
+    }
+
+    @Override
+    public <T> T unwrap(Class<T> iface) throws SQLException {
+        if (iface.isInstance(this)) {
+            return iface.cast(this);
+        }
+        throw new SQLException("not a wrapper for " + iface);
+    }
+
+    @Override
+    public boolean isWrapperFor(Class<?> iface) {
+        return iface.isInstance(this);
+    }
+}

@@ -97,10 +97,18 @@ def test_factor_or_respects_qualifiers():
     b2 = ex.BinaryExpr(ex.BinaryExpr(n1x, "=", ex.lit("B")), "and",
                        ex.BinaryExpr(n2x, "=", ex.lit("A")))
     out = factor_or(ex.BinaryExpr(b1, "or", b2))
-    assert len(out) == 1  # nothing common: the OR survives intact
+    # nothing common: the OR survives intact as the first conjunct...
+    assert out[0].name() == ex.BinaryExpr(b1, "or", b2).name()
+    # ...plus IMPLIED per-column IN lists (every branch pins n1.x/n2.x to
+    # a literal, so the OR implies membership — pushable to the scans,
+    # the q7 shape)
+    ins = {c.expr.relation: sorted(v.value for v in c.list)
+           for c in out[1:]}
+    assert ins == {"n1": ["A", "B"], "n2": ["A", "B"]}
     # and a genuinely common conjunct still factors
     common = ex.BinaryExpr(ex.ColumnRef("k", "t"), "=", ex.lit(1))
     c1 = ex.BinaryExpr(common, "and", ex.BinaryExpr(n1x, "=", ex.lit("A")))
     c2 = ex.BinaryExpr(common, "and", ex.BinaryExpr(n1x, "=", ex.lit("B")))
     out2 = factor_or(ex.BinaryExpr(c1, "or", c2))
-    assert len(out2) == 2
+    assert out2[0].name() == common.name()
+    assert len(out2) == 3  # common + residual OR + implied n1.x IN (A,B)
