@@ -101,12 +101,36 @@ def grouped_aggregate(
     # boundary detection needs no extra gathers either.
     dead = jnp.logical_not(live)
     idx = jnp.arange(n, dtype=jnp.int32)
-    sorted_ops = jax.lax.sort(
-        (dead, *eff_keys, idx), num_keys=1 + len(eff_keys), is_stable=True
-    )
-    order = sorted_ops[-1]
-    sorted_keys = sorted_ops[1:-1]
-    live_sorted = jnp.logical_not(sorted_ops[0])
+    if len(eff_keys) == 1:
+        # PRESORTED fast path (runtime-branched, no host sync): group-by
+        # over a clustered key (TPC-H q18's l_orderkey — file order) can
+        # skip the O(N log N) sort entirely when the key is already
+        # non-decreasing over a contiguous live prefix. lax.cond executes
+        # only the taken branch, so unsorted inputs pay one O(N) check.
+        k0 = eff_keys[0]
+        live_prefix = jnp.all(live[1:] <= live[:-1])  # no live after dead
+        nondecreasing = jnp.all(
+            jnp.logical_or(k0[1:] >= k0[:-1], jnp.logical_not(live[1:]))
+        )
+        presorted = jnp.logical_and(live_prefix, nondecreasing)
+
+        def _fast(_):
+            return idx, (k0,), live
+
+        def _slow(_):
+            ops = jax.lax.sort((dead, k0, idx), num_keys=2, is_stable=True)
+            return ops[-1], (ops[1],), jnp.logical_not(ops[0])
+
+        order, sorted_keys, live_sorted = jax.lax.cond(
+            presorted, _fast, _slow, None)
+    else:
+        sorted_ops = jax.lax.sort(
+            (dead, *eff_keys, idx), num_keys=1 + len(eff_keys),
+            is_stable=True
+        )
+        order = sorted_ops[-1]
+        sorted_keys = sorted_ops[1:-1]
+        live_sorted = jnp.logical_not(sorted_ops[0])
 
     # a row starts a new group if live and ANY key differs from predecessor
     first = None

@@ -1,0 +1,127 @@
+"""BASELINE config-4 analogue: q5-class queries through a REAL cluster
+(scheduler + N executors, hash-shuffle stages over the data plane),
+cross-checked against the standalone engine on the same data.
+
+The reference's config is "TPC-H q5 SF=100, 4 executors, Flight shuffle"
+(BASELINE.json); SF=100 needs ~90GB of .tbl which exceeds this box's
+disk, so the default here is the largest disk-feasible scale — the
+structure (4 executors, multi-stage shuffle plan, partitioned joins) is
+the config's point. On real TPU slices the same plan fuses into
+MeshAgg/MeshJoin SPMD stages (see benchmarks/scaling.py).
+
+Usage: python benchmarks/cluster_run.py --data bench_data/sf30
+           [--executors 4] [--queries q5] [--runs 2] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+if os.environ.get("BALLISTA_CLUSTER_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+QDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tpch",
+                    "queries")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--executors", type=int, default=4)
+    ap.add_argument("--concurrent-tasks", type=int, default=2)
+    ap.add_argument("--queries", default="q5")
+    ap.add_argument("--runs", type=int, default=2)
+    ap.add_argument("--shuffle-partitions", default="8",
+                    help="hash-shuffle width for joins AND aggregations "
+                         "(maps to the join.partitions/agg.partitions "
+                         "settings)")
+    ap.add_argument("--skip-standalone-check", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.distributed.executor import LocalCluster
+    from benchmarks.tpch.schema_def import register_tpch
+
+    result = {
+        "data": args.data,
+        "platform": jax.devices()[0].platform,
+        "executors": args.executors,
+        "concurrent_tasks": args.concurrent_tasks,
+        "shuffle_partitions": args.shuffle_partitions,
+        "queries": {},
+    }
+    cluster = LocalCluster(num_executors=args.executors,
+                           concurrent_tasks=args.concurrent_tasks)
+    try:
+        ctx = BallistaContext.remote(
+            "localhost", cluster.port,
+            **{"join.partitions": args.shuffle_partitions,
+               "agg.partitions": args.shuffle_partitions})
+        register_tpch(ctx, args.data, "tbl")
+        for qname in args.queries.split(","):
+            qname = qname.strip()
+            sql = open(os.path.join(QDIR, f"{qname}.sql")).read()
+            t0 = time.time()
+            out = ctx.sql(sql).collect()
+            first = time.time() - t0
+            times = []
+            for _ in range(args.runs - 1):
+                t0 = time.time()
+                out = ctx.sql(sql).collect()
+                times.append(time.time() - t0)
+            entry = {
+                "first_s": round(first, 2),
+                "rows_out": int(len(out)),
+            }
+            if times:
+                entry["warm_s"] = round(min(times), 2)
+            print(f"# cluster {qname}: first={first:.1f}s "
+                  f"warm={min(times) if times else float('nan'):.1f}s "
+                  f"rows={len(out)}", file=sys.stderr)
+            if not args.skip_standalone_check:
+                sctx = BallistaContext.standalone()
+                register_tpch(sctx, args.data, "tbl")
+                t0 = time.time()
+                sa = sctx.sql(sql).collect()
+                entry["standalone_s"] = round(time.time() - t0, 2)
+                sort_cols = list(out.columns)
+                a = out.sort_values(sort_cols).reset_index(drop=True)
+                b = sa.sort_values(sort_cols).reset_index(drop=True)
+                assert len(a) == len(b), (len(a), len(b))
+                for c in a.columns:
+                    if b[c].dtype.kind in "fc":
+                        np.testing.assert_allclose(
+                            a[c].astype(float), b[c].astype(float),
+                            rtol=1e-5, atol=1e-5, err_msg=f"{qname}.{c}")
+                    else:
+                        assert list(a[c].astype(str)) == \
+                            list(b[c].astype(str)), f"{qname}.{c}"
+                entry["matches_standalone"] = True
+                print(f"# cluster {qname}: matches standalone "
+                      f"({entry['standalone_s']}s)", file=sys.stderr)
+                del sctx
+            result["queries"][qname] = entry
+    finally:
+        cluster.shutdown()
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
