@@ -118,24 +118,41 @@ def wait_for_job(host: str, port: int, job_id: str,
         client.close()
 
 
+def _job_timeout(settings: Optional[Dict[str, str]],
+                 override: Optional[float]) -> float:
+    """Seconds to wait for a remote job: explicit arg > ``job.timeout``
+    setting > 300 (large-SF runs on few cores legitimately exceed the
+    default)."""
+    if override is not None:
+        return override
+    raw = (settings or {}).get("job.timeout", 300.0)
+    try:
+        return float(raw)
+    except ValueError:
+        raise ClusterError(f"invalid job.timeout setting: {raw!r} "
+                           "(expected seconds as a number)") from None
+
+
 def remote_collect(host: str, port: int, logical_plan,
                    settings: Optional[Dict[str, str]] = None,
-                   timeout: float = 300.0):
+                   timeout: Optional[float] = None):
     """Submit + poll + fetch -> pandas DataFrame."""
     from ..execution import resolve_scalar_subqueries
 
+    deadline = _job_timeout(settings, timeout)  # fail fast pre-submit
     logical_plan = resolve_scalar_subqueries(logical_plan)
     job_id = submit_plan(host, port, logical_plan, settings)
-    result = wait_for_job(host, port, job_id, timeout)
+    result = wait_for_job(host, port, job_id, deadline)
     return _fetch_result_frames(result)
 
 
 def remote_sql_collect(host: str, port: int, sql: str, catalog,
                        settings: Optional[Dict[str, str]] = None,
-                       timeout: float = 300.0):
+                       timeout: Optional[float] = None):
     """Raw-SQL round trip: submit SQL + catalog, poll, fetch."""
+    deadline = _job_timeout(settings, timeout)  # fail fast pre-submit
     job_id = submit_sql(host, port, sql, catalog, settings)
-    result = wait_for_job(host, port, job_id, timeout)
+    result = wait_for_job(host, port, job_id, deadline)
     return _fetch_result_frames(result)
 
 

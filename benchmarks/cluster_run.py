@@ -47,6 +47,9 @@ def main():
                          "(maps to the join.partitions/agg.partitions "
                          "settings)")
     ap.add_argument("--skip-standalone-check", action="store_true")
+    ap.add_argument("--timeout", type=float, default=7200.0,
+                    help="per-query job timeout seconds (large SF on few "
+                         "cores runs long)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -68,7 +71,8 @@ def main():
         ctx = BallistaContext.remote(
             "localhost", cluster.port,
             **{"join.partitions": args.shuffle_partitions,
-               "agg.partitions": args.shuffle_partitions})
+               "agg.partitions": args.shuffle_partitions,
+               "job.timeout": str(args.timeout)})
         register_tpch(ctx, args.data, "tbl")
         for qname in args.queries.split(","):
             qname = qname.strip()

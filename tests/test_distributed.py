@@ -224,6 +224,33 @@ def test_cluster_query_end_to_end(cluster, tmp_path):
     np.testing.assert_array_equal(got["n"], exp["n"])
 
 
+def test_cluster_job_timeout_setting(cluster, tmp_path):
+    """job.timeout is honored on both remote collect paths: a zero
+    timeout trips before completion, a generous one completes, and a
+    malformed value fails fast (pre-submit) with a tagged error."""
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.errors import ClusterError
+
+    src = _mem_table(tmp_path)
+    ctx = BallistaContext.remote("localhost", cluster.port,
+                                 **{"job.timeout": "0.0"})
+    ctx.register_source("t", src)
+    with pytest.raises(ClusterError, match="timed out"):
+        ctx.sql("select count(*) as n from t").collect()
+
+    ctx = BallistaContext.remote("localhost", cluster.port,
+                                 **{"job.timeout": "not-a-number"})
+    ctx.register_source("t", src)
+    with pytest.raises(ClusterError, match="job.timeout"):
+        ctx.sql("select count(*) as n from t").collect()
+
+    ctx = BallistaContext.remote("localhost", cluster.port,
+                                 **{"job.timeout": "120"})
+    ctx.register_source("t", src)
+    got = ctx.sql("select count(*) as n from t").collect()
+    assert int(got["n"][0]) == 100
+
+
 def test_cluster_join_query(cluster, tmp_path):
     from ballista_tpu.client import BallistaContext
 
