@@ -271,13 +271,15 @@ class LocalCluster:
     """In-process scheduler + N executors (for tests and single-host use)."""
 
     def __init__(self, num_executors: int = 2, concurrent_tasks: int = 2,
-                 scheduler_port: int = 0, num_devices: int = 1):
+                 scheduler_port: int = 0, num_devices: int = 1,
+                 speculation_age_secs: float = 60.0):
         from .scheduler import serve_scheduler
         from .state import MemoryBackend, SchedulerState
 
         self.state = SchedulerState(MemoryBackend())
         self.server, self.service, self.port = serve_scheduler(
-            self.state, "localhost", scheduler_port
+            self.state, "localhost", scheduler_port,
+            speculation_age_secs=speculation_age_secs,
         )
         self.executors = []
         for _ in range(num_executors):

@@ -50,6 +50,10 @@ def main():
     ap.add_argument("--timeout", type=float, default=7200.0,
                     help="per-query job timeout seconds (large SF on few "
                          "cores runs long)")
+    ap.add_argument("--speculation-secs", type=float, default=0.0,
+                    help="straggler speculation age; 0 = off (the default "
+                         "here: on a shared-core box every task looks like "
+                         "a straggler and duplicates strictly add work)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -63,10 +67,12 @@ def main():
         "executors": args.executors,
         "concurrent_tasks": args.concurrent_tasks,
         "shuffle_partitions": args.shuffle_partitions,
+        "speculation_secs": args.speculation_secs,
         "queries": {},
     }
     cluster = LocalCluster(num_executors=args.executors,
-                           concurrent_tasks=args.concurrent_tasks)
+                           concurrent_tasks=args.concurrent_tasks,
+                           speculation_age_secs=args.speculation_secs)
     try:
         ctx = BallistaContext.remote(
             "localhost", cluster.port,
