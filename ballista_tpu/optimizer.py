@@ -13,6 +13,7 @@ implemented natively:
 
 from __future__ import annotations
 
+import dataclasses
 from typing import List, Optional, Set
 
 from . import expr as ex
@@ -181,8 +182,9 @@ def push_filters(plan: LogicalPlan) -> LogicalPlan:
         return Repartition(push_filters(plan.input), plan.num_partitions,
                            plan.hash_exprs)
     if isinstance(plan, Join):
-        return Join(push_filters(plan.left), push_filters(plan.right),
-                    plan.on, plan.how)
+        # dataclasses.replace: never silently drop a Join field
+        return dataclasses.replace(plan, left=push_filters(plan.left),
+                                   right=push_filters(plan.right))
     if isinstance(plan, Explain):
         return Explain(push_filters(plan.input), plan.verbose)
     return plan
@@ -204,7 +206,7 @@ def _sink(conjuncts: List[ex.Expr], node: LogicalPlan) -> LogicalPlan:
                 keep.append(c)
         left = _sink(left_preds, node.left) if left_preds else node.left
         right = _sink(right_preds, node.right) if right_preds else node.right
-        out: LogicalPlan = Join(left, right, node.on, node.how)
+        out: LogicalPlan = dataclasses.replace(node, left=left, right=right)
         if keep:
             out = Filter(conjoin(keep), out)
         return out
@@ -269,8 +271,9 @@ def prune_columns(plan: LogicalPlan, required: Optional[Set[str]]) -> LogicalPla
         else:
             lneed = (set(required) & lnames) | on_l
             rneed = (set(required) & rnames) | on_r
-        return Join(prune_columns(plan.left, lneed),
-                    prune_columns(plan.right, rneed), plan.on, plan.how)
+        return dataclasses.replace(plan,
+                                   left=prune_columns(plan.left, lneed),
+                                   right=prune_columns(plan.right, rneed))
     if isinstance(plan, Explain):
         return Explain(prune_columns(plan.input, None), plan.verbose)
     return plan

@@ -66,6 +66,24 @@ def test_not_in_subquery_null_semantics(ctx):
     assert sorted(out2["ckey"]) == [1, 2, 3]
 
 
+def test_not_in_subquery_with_actual_null(ctx, tmp_path):
+    """A NULL value IN the subquery output empties NOT IN entirely.
+
+    Regression: the optimizer's Join reconstructions dropped the
+    null_aware flag, silently degrading NOT IN to a plain anti join."""
+    p = tmp_path / "nv.tbl"
+    p.write_text("1|x|\n|y|\n")  # second key is NULL
+    from ballista_tpu.io import TblSource
+
+    ctx.register_source(
+        "nullvals", TblSource(str(p), schema(("k", Int64), ("s", Utf8)))
+    )
+    out = ctx.sql(
+        "select ckey from cust where ckey not in (select k from nullvals)"
+    ).collect()
+    assert list(out["ckey"]) == []
+
+
 def test_scalar_subquery_empty_is_null(ctx):
     out = ctx.sql(
         "select ckey from cust where ckey > "
