@@ -35,7 +35,76 @@ from .logical import (
 
 def optimize(plan: LogicalPlan) -> LogicalPlan:
     plan = push_filters(plan)
+    plan = push_semi_joins(plan)
     plan = prune_columns(plan, None)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# Semi/anti-join pushdown
+# ---------------------------------------------------------------------------
+
+
+def _map_children(plan: LogicalPlan, fn) -> LogicalPlan:
+    """Rebuild ``plan`` with ``fn`` applied to every LogicalPlan field."""
+    updates = {
+        f.name: fn(v)
+        for f in dataclasses.fields(plan)
+        if isinstance(v := getattr(plan, f.name), LogicalPlan)
+    }
+    return dataclasses.replace(plan, **updates) if updates else plan
+
+
+def _may_prune(plan: LogicalPlan) -> bool:
+    """True when the subtree can shrink cardinality beyond FK matching
+    (filters, limits, aggregates, semi/anti joins)."""
+    if isinstance(plan, (Filter, Limit, Aggregate)):
+        return True
+    if isinstance(plan, Join) and plan.how in ("semi", "anti"):
+        return True
+    return any(_may_prune(c) for c in plan.children())
+
+
+def push_semi_joins(plan: LogicalPlan) -> LogicalPlan:
+    """Sink a semi/anti join below an inner join toward the input that
+    produces its key columns.
+
+    ``(A ⋈ B) ⋉ S`` on a key from A rewrites to ``(A ⋉ S) ⋈ B``: the
+    key column rides through the inner join unchanged, so membership
+    against S filters the same rows — but now BEFORE the join, so the
+    join (and everything above it) runs at the pruned size. TPC-H q18's
+    IN-subquery semi drops from probing the full 3-table join output to
+    pruning orders at the scan (6M-row join shapes -> tens of rows).
+
+    Guard: only applied when the OTHER inner-join input cannot itself
+    prune (no filters/limits/aggregates/semi-antis beneath it). When it
+    can — q21's exists/not-exists over a heavily filtered join — the
+    child join may shrink the key side far below the pre-join table,
+    and hoisted (current) placement probes fewer rows. Runs after
+    push_filters so filters sit at their final depth.
+
+    The reference gets this class of transform from DataFusion's
+    decorrelation/filter-pushdown stack (reference: rust/scheduler/src/
+    lib.rs:317-331 delegates to ctx.optimize); here it is native."""
+    plan = _map_children(plan, push_semi_joins)
+    if not (isinstance(plan, Join) and plan.how in ("semi", "anti")):
+        return plan
+    child = plan.left
+    if not (isinstance(child, Join) and child.how == "inner"):
+        return plan
+    keys = [l for l, _ in plan.on]
+    lnames = set(child.left.schema().names())
+    rnames = set(child.right.schema().names())
+    # name collisions resolve to the inner join's LEFT output column
+    if all(k in lnames for k in keys) and not _may_prune(child.right):
+        pushed = Join(child.left, plan.right, plan.on, plan.how,
+                      plan.null_aware)
+        return dataclasses.replace(child, left=push_semi_joins(pushed))
+    if (all(k in rnames and k not in lnames for k in keys)
+            and not _may_prune(child.left)):
+        pushed = Join(child.right, plan.right, plan.on, plan.how,
+                      plan.null_aware)
+        return dataclasses.replace(child, right=push_semi_joins(pushed))
     return plan
 
 

@@ -391,23 +391,33 @@ class HashAggregateExec(PhysicalPlan):
                 pass  # no live rows: sort path handles the empty batch
             else:
                 spans, bases = [], []
+                true_total = 1  # product of UNQUANTIZED spans
                 it = iter(mm)
                 for kind, slots in layout:
                     if kind == "dict":
                         spans.append(slots)
+                        true_total *= slots
                     else:
                         lo, hi = next(it)
                         # +1 NULL slot; quantized so successive batches
                         # with similar ranges reuse one compiled program
                         spans.append(round_capacity(hi - lo + 2))
                         bases.append(lo)
+                        true_total *= hi - lo + 2
                 g_total = 1
                 for s in spans:
                     g_total *= s
                 # admission gates on LIVE rows (not capacity): sparse
-                # post-filter batches must not allocate huge group tables
-                if g_total <= min(self._RANGED_DENSE_LIMIT,
-                                  self._RANGED_CAP_FACTOR * (nlive + 256)):
+                # post-filter batches must not allocate huge group tables.
+                # The rows-proportional test uses the TRUE span product —
+                # quantization (up to 2x per int key) is a compile-reuse
+                # artifact, not a cost the data asked for; a 1.5M-group
+                # final agg over a 6M-wide key must not lose the O(N)
+                # path because 6M rounds to 8.4M (q18's HAVING subquery:
+                # 3.7s sort -> 0.2s scatter). The quantized table still
+                # has to fit the absolute limit.
+                if (true_total <= self._RANGED_CAP_FACTOR * (nlive + 256)
+                        and g_total <= self._RANGED_DENSE_LIMIT):
                     fn = self._get_mixed_fn(tuple(spans), batch.capacity,
                                             layout)
                     out, _ng = fn(batch, jnp.asarray(bases, jnp.int64))

@@ -27,6 +27,8 @@ def main():
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "bench_data", "sf1"))
     ap.add_argument("--runs", type=int, default=2)
+    ap.add_argument("--sql", default=None,
+                    help="profile this SQL string instead of --query")
     args = ap.parse_args()
 
     import jax
@@ -39,7 +41,7 @@ def main():
 
     ctx = BallistaContext.standalone()
     register_tpch(ctx, args.data, "tbl", cached=True)
-    sql = open(os.path.join(
+    sql = args.sql or open(os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "benchmarks", "tpch", "queries", f"{args.query}.sql")).read()
     df = ctx.sql(sql)
