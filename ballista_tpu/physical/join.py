@@ -225,6 +225,16 @@ class JoinExec(PhysicalPlan):
         bf = list(bs.fields)
         return Schema(bf + extra)
 
+    def estimated_rows(self):
+        """Semi/anti joins emit a SUBSET of the probe side — the base
+        sum-of-children over-estimate would also count the membership
+        list, inflating a pruned side enough to flip cost-based
+        orientation the wrong way (q18's IN-subquery side estimated
+        above the full lineitem scan)."""
+        if self.how in ("semi", "anti"):
+            return self.probe.estimated_rows()
+        return super().estimated_rows()
+
     def output_partitioning(self) -> Partitioning:
         if self.how == "full":
             # one task streams every probe partition and appends the

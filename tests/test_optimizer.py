@@ -1,0 +1,81 @@
+"""Optimizer rewrite unit tests: semi/anti-join pushdown branches.
+
+Direct plan-shape coverage for ``push_semi_joins`` — the TPC-H oracle
+suite exercises only the shapes those 22 queries happen to contain, so
+each guard branch is pinned here (push-left, push-right, the
+name-collision left-wins rule, and the pruning-other-side suppression).
+"""
+
+import numpy as np
+
+from ballista_tpu import schema, Int64, lit, col
+from ballista_tpu.io import MemTableSource
+from ballista_tpu.logical import Filter, Join, TableScan
+from ballista_tpu.optimizer import push_semi_joins
+
+
+def _scan(name, cols, n=10):
+    s = schema(*[(c, Int64) for c in cols])
+    src = MemTableSource.from_pydict(
+        s, {c: np.arange(n) for c in cols})
+    return TableScan(name, src)
+
+
+def _sub():
+    return _scan("s", ["sk"], n=3)
+
+
+def test_push_left_through_inner():
+    a, b = _scan("a", ["ak", "x"]), _scan("b", ["bk", "y"])
+    inner = Join(a, b, on=[("ak", "bk")], how="inner")
+    plan = Join(inner, _sub(), on=[("x", "sk")], how="semi")
+    out = push_semi_joins(plan)
+    assert isinstance(out, Join) and out.how == "inner"
+    assert isinstance(out.left, Join) and out.left.how == "semi"
+    assert out.left.left is a
+    assert out.right is b
+
+
+def test_push_right_through_inner():
+    a, b = _scan("a", ["ak", "x"]), _scan("b", ["bk", "y"])
+    inner = Join(a, b, on=[("ak", "bk")], how="inner")
+    plan = Join(inner, _sub(), on=[("y", "sk")], how="anti",
+                null_aware=True)
+    out = push_semi_joins(plan)
+    assert isinstance(out, Join) and out.how == "inner"
+    assert isinstance(out.right, Join) and out.right.how == "anti"
+    assert out.right.null_aware  # flag rides the pushed join
+    assert out.right.left is b
+    assert out.left is a
+
+
+def test_collision_resolves_left_only():
+    # both inputs expose column "k"; the inner join's output keeps the
+    # LEFT one, so a semi keyed on "k" may only push left
+    a, b = _scan("a", ["k", "ak"]), _scan("b", ["k", "bk"])
+    inner = Join(a, b, on=[("ak", "bk")], how="inner")
+    plan = Join(inner, _sub(), on=[("k", "sk")], how="semi")
+    out = push_semi_joins(plan)
+    assert out.how == "inner"
+    assert isinstance(out.left, Join) and out.left.how == "semi"
+    assert out.left.left is a  # never lands on b despite b also having k
+
+
+def test_no_push_when_other_side_prunes():
+    # the other inner-join input carries a filter: its join may shrink
+    # the key side below the pre-join table, so placement stays hoisted
+    a = _scan("a", ["ak", "x"])
+    b = Filter(col("bk") > lit(2), _scan("b", ["bk", "y"]))
+    inner = Join(a, b, on=[("ak", "bk")], how="inner")
+    plan = Join(inner, _sub(), on=[("x", "sk")], how="semi")
+    out = push_semi_joins(plan)
+    # unchanged shape: semi stays above the join
+    assert out.how == "semi" and out.left.how == "inner"
+
+
+def test_no_push_through_outer_join():
+    a, b = _scan("a", ["ak", "x"]), _scan("b", ["bk", "y"])
+    left = Join(a, b, on=[("ak", "bk")], how="left")
+    plan = Join(left, _sub(), on=[("x", "sk")], how="semi")
+    out = push_semi_joins(plan)
+    assert out.how == "semi" and out.left.how == "left"

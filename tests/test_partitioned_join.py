@@ -77,6 +77,30 @@ def test_planner_emits_partitioned_join_above_threshold():
     assert j2 is not None and not j2.partitioned
 
 
+def test_semi_join_estimate_bounds_orientation():
+    """A semi/anti join estimates as its PROBE side, not the child sum:
+    the membership list must not inflate a pruned input's estimate.
+    q18's IN-subquery side otherwise estimated above the full lineitem
+    scan and the cost swap built the wrong (cheap-to-reprobe) side."""
+    lsrc, _ = _mem(100, 10, "l")
+    rsrc, _ = _mem(40, 10, "r")
+    ssrc, _ = _mem(500, 10, "s")  # big membership list
+    pruned = Join(TableScan("r", rsrc), TableScan("s", ssrc),
+                  on=[("rk", "sk")], how="semi")
+    phys_semi = create_physical_plan(pruned, PlannerOptions())
+    sj = _find_join(phys_semi)
+    assert sj.estimated_rows() == 40  # probe side, NOT 40 + 500
+    # cost swap: the truly-larger plain side (l, 100) becomes the
+    # partitioned build even though r's SUBTREE sums to 540
+    plan = Join(TableScan("l", lsrc), pruned,
+                on=[("lk", "rk")], how="inner")
+    opts = PlannerOptions(join_partition_threshold=10, join_partitions=4)
+    j = _find_join(create_physical_plan(plan, opts))
+    assert j is not None and j.partitioned
+    assert [e.name() for e in j.build.hash_exprs] == ["lk"]
+    assert [e.name() for e in j.probe.hash_exprs] == ["rk"]
+
+
 def test_stage_dag_shape_for_partitioned_join():
     lsrc, _ = _mem(100, 10, "l")
     rsrc, _ = _mem(40, 10, "r")
