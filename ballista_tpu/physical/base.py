@@ -83,6 +83,13 @@ class PipelineOp(PhysicalPlan):
     """
 
     child: PhysicalPlan
+    # True for transforms that can kill rows (FilterExec): the fused
+    # chain's output is then adaptively compacted, so a selective filter
+    # hands every downstream operator a capacity sized to the survivors
+    # instead of the scan's (q15's 3-month window keeps 7.5% of lineitem
+    # but aggregation paid full-capacity passes). Same policy/guards as
+    # post-join compaction (maybe_compact: >=4x shrink, sync-cost-aware).
+    compactable = False
 
     def device_transform(self, batch: ColumnBatch) -> ColumnBatch:
         raise NotImplementedError(type(self).__name__)
@@ -114,8 +121,29 @@ class PipelineOp(PhysicalPlan):
 
             fused = jax.jit(apply_all)
             self._fused_fn = fused
+        # Adaptive: a filter's selectivity is stationary within a query,
+        # so after 2 consecutive batches that decline to compact, stop
+        # paying the per-batch live-count sync for the operator's
+        # lifetime (it would otherwise serialize host scan parsing
+        # against device compute batch-by-batch for zero benefit on
+        # unselective filters). The learned capacity floor keeps later
+        # batches from compacting to ever-different power-of-two rungs,
+        # bounding downstream per-capacity jit compiles to ~one extra.
+        compact = any(op.compactable for op in chain)
         for batch in source.execute(partition):
-            yield fused(batch)
+            out = fused(batch)
+            if compact and getattr(self, "_compact_misses", 0) < 2:
+                res = maybe_compact(
+                    out, floor=getattr(self, "_compact_floor", 8))
+                if res is out:
+                    self._compact_misses = \
+                        getattr(self, "_compact_misses", 0) + 1
+                else:
+                    self._compact_misses = 0
+                    self._compact_floor = max(
+                        getattr(self, "_compact_floor", 8), res.capacity)
+                out = res
+            yield out
 
 
 # ---------------------------------------------------------------------------
@@ -203,7 +231,8 @@ def _record_sync_cost(batch: ColumnBatch) -> None:
 
 
 def maybe_compact(batch: ColumnBatch, shrink_factor: int = 4,
-                  known_rows: Optional[int] = None) -> ColumnBatch:
+                  known_rows: Optional[int] = None,
+                  floor: int = 8) -> ColumnBatch:
     """Shrink a sparse batch: when live rows fill under 1/shrink_factor
     of the capacity, gather them to the front of a smaller batch. One
     sort+gather now buys every downstream operator a smaller shape —
@@ -226,7 +255,7 @@ def maybe_compact(batch: ColumnBatch, shrink_factor: int = 4,
         if first:
             _record_sync_cost(batch)  # pure-RTT measurement
     cap = batch.capacity
-    new_cap = max(round_capacity(n), 8)
+    new_cap = max(round_capacity(n), floor, 8)
     if new_cap * shrink_factor > cap:
         return batch
     key = (cap, new_cap)

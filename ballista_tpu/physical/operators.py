@@ -85,6 +85,8 @@ class ScanExec(PhysicalPlan):
 
 
 class FilterExec(PipelineOp):
+    compactable = True  # kills rows: fused chain output is compacted
+
     def __init__(self, predicate: ex.Expr, child: PhysicalPlan):
         self.predicate = predicate
         self.child = child
