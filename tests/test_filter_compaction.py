@@ -1,0 +1,54 @@
+"""Adaptive post-filter compaction: plan-level behavior pins.
+
+A fused scan->filter pipeline compacts its output when few rows
+survive (downstream operators then run at the survivors' capacity) and
+backs off the per-batch live-count sync when the filter is
+unselective (selectivity is stationary within a query).
+"""
+
+import numpy as np
+
+from ballista_tpu import schema, col, lit, Int64
+from ballista_tpu.io import MemTableSource
+from ballista_tpu.physical.operators import FilterExec, ScanExec
+
+
+def _scan(n, capacity=None):
+    s = schema(("k", Int64), ("v", Int64))
+    src = MemTableSource.from_pydict(
+        s, {"k": np.arange(n), "v": np.arange(n)}, capacity=capacity)
+    return ScanExec("t", src)
+
+
+def test_selective_filter_compacts_output():
+    f = FilterExec(col("k") < lit(10), _scan(4096))
+    batches = list(f.execute(0))
+    assert len(batches) == 1
+    b = batches[0]
+    assert int(b.num_rows) == 10
+    # capacity shrank to the survivors' power-of-two, not the scan's 4096
+    assert b.capacity < 4096 // 4
+    assert sorted(np.asarray(b.column("k").values)[:10].tolist()) == \
+        list(range(10))
+
+
+def test_unselective_filter_keeps_capacity_and_backs_off():
+    f = FilterExec(col("k") >= lit(0), _scan(4096))  # keeps everything
+    b = next(iter(f.execute(0)))
+    assert b.capacity == 4096
+    assert int(b.num_rows) == 4096
+    # two no-compact batches end the per-batch live-count sync
+    list(f.execute(0))
+    assert f._compact_misses >= 2
+    list(f.execute(0))
+    assert f._compact_misses == 2  # stopped counting: sync path skipped
+
+
+def test_learned_floor_reuses_capacity():
+    f = FilterExec(col("k") < lit(100), _scan(4096))
+    b1 = next(iter(f.execute(0)))
+    cap1 = b1.capacity
+    # later executions (other partitions/runs) compact to the SAME rung
+    b2 = next(iter(f.execute(0)))
+    assert b2.capacity == cap1
+    assert f._compact_floor == cap1
