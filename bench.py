@@ -354,10 +354,15 @@ def _run_bench(args) -> None:
     # -- data ---------------------------------------------------------------
     data_dir = os.path.join(args.data, f"sf{args.scale:g}")
     marker = os.path.join(data_dir, ".complete")
-    if not os.path.exists(marker):
+    want = f"v{datagen.DATAGEN_VERSION}"
+    have = open(marker).read().strip() if os.path.exists(marker) else None
+    if have != want:
+        if have is not None:
+            print(f"# datagen version changed ({have} -> {want}): "
+                  f"regenerating sf{args.scale:g}", file=sys.stderr)
         t0 = time.time()
         datagen.generate(data_dir, scale=args.scale, num_parts=1)
-        open(marker, "w").write("ok")
+        open(marker, "w").write(want)
         print(f"# generated sf{args.scale:g} in {time.time()-t0:.1f}s",
               file=sys.stderr)
 

@@ -37,6 +37,11 @@ NATIONS = [
     ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
     ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
 ]
+# Bump when the generated DISTRIBUTION changes (not just speed): callers
+# caching generated dirs key their freshness marker on this, so stale
+# data from an older generator is regenerated instead of silently reused.
+DATAGEN_VERSION = 2  # v2: custkey%3==0 get no orders (dbgen rule, q22)
+
 REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
 SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
 PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
@@ -140,7 +145,15 @@ def _gen_orders_chunk(rng, lo, hi, n_cust, n_part, n_supp):
     chunk's orders only, so peak memory is O(chunk)."""
     n = hi - lo
     okey = (np.arange(lo, hi) + 1) * 4 - 3  # sparse keys like dbgen
-    o_cust = rng.integers(1, n_cust + 1, n)
+    # dbgen never assigns orders to custkey % 3 == 0 (a third of
+    # customers have no orders) — q22's "customers without orders"
+    # anti-join is vacuously empty without this. Drawn uniformly over
+    # the non-multiples via j -> j + (j-1)//2 (the j-th positive
+    # integer not divisible by 3), so every eligible customer has the
+    # same order probability.
+    n_eligible = n_cust - n_cust // 3
+    j = rng.integers(1, n_eligible + 1, n)
+    o_cust = j + (j - 1) // 2
     span = int((END_ORDER - START) / np.timedelta64(1, "D"))
     o_date = START + rng.integers(0, span, n).astype("timedelta64[D]")
     orders_cols = [
