@@ -73,6 +73,51 @@ def test_no_push_when_other_side_prunes():
     assert out.how == "semi" and out.left.how == "inner"
 
 
+def test_filter_conjuncts_sink_to_join_sides():
+    from ballista_tpu.optimizer import push_filters
+
+    a, b = _scan("a", ["ak", "x"]), _scan("b", ["bk", "y"])
+    inner = Join(a, b, on=[("ak", "bk")], how="inner")
+    pred = ((col("x") > lit(1)) & (col("y") > lit(2))
+            & (col("x") < col("y")))
+    out = push_filters(Filter(pred, inner))
+    from ballista_tpu import expr as ex
+
+    # cross-side conjunct (references both inputs) stays above the join
+    assert isinstance(out, Filter)
+    assert set(ex.referenced_columns(out.predicate)) == {"x", "y"}
+    j = out.input
+    assert isinstance(j, Join)
+    # ...single-side conjuncts sank to their input
+    assert isinstance(j.left, Filter) and j.left.predicate.name().find("x") >= 0
+    assert isinstance(j.right, Filter) and j.right.predicate.name().find("y") >= 0
+
+
+def test_prune_columns_reaches_scans():
+    from ballista_tpu.logical import Projection
+    from ballista_tpu.optimizer import prune_columns
+    from ballista_tpu import expr as ex
+
+    a, b = _scan("a", ["ak", "x", "unused1"]), _scan("b", ["bk", "y", "unused2"])
+    inner = Join(a, b, on=[("ak", "bk")], how="inner")
+    plan = Projection([ex.ColumnRef("x"), ex.ColumnRef("y")], inner)
+    out = prune_columns(plan, None)
+    scans = []
+
+    def walk(p):
+        if isinstance(p, TableScan):
+            scans.append(p)
+        for c in p.children():
+            walk(c)
+
+    walk(out)
+    got = {s.table_name: set(s.projection or s.schema().names())
+           for s in scans}
+    # join keys + referenced columns only; unused columns pruned
+    assert got["a"] == {"ak", "x"}, got
+    assert got["b"] == {"bk", "y"}, got
+
+
 def test_no_push_through_outer_join():
     a, b = _scan("a", ["ak", "x"]), _scan("b", ["bk", "y"])
     left = Join(a, b, on=[("ak", "bk")], how="left")
