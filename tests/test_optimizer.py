@@ -1,17 +1,24 @@
-"""Optimizer rewrite unit tests: semi/anti-join pushdown branches.
+"""Optimizer rewrite unit tests: direct plan-shape coverage.
 
-Direct plan-shape coverage for ``push_semi_joins`` — the TPC-H oracle
-suite exercises only the shapes those 22 queries happen to contain, so
-each guard branch is pinned here (push-left, push-right, the
-name-collision left-wins rule, and the pruning-other-side suppression).
+The TPC-H oracle suite exercises only the shapes those 22 queries
+happen to contain, so each rewrite is pinned here directly:
+``push_semi_joins`` guard branches (push-left, push-right, the
+name-collision left-wins rule, the pruning-other-side suppression),
+``push_filters`` conjunct sinking, and ``prune_columns`` reaching
+scans.
 """
 
 import numpy as np
 
 from ballista_tpu import schema, Int64, lit, col
+from ballista_tpu import expr as ex
 from ballista_tpu.io import MemTableSource
-from ballista_tpu.logical import Filter, Join, TableScan
-from ballista_tpu.optimizer import push_semi_joins
+from ballista_tpu.logical import Filter, Join, Projection, TableScan
+from ballista_tpu.optimizer import (
+    prune_columns,
+    push_filters,
+    push_semi_joins,
+)
 
 
 def _scan(name, cols, n=10):
@@ -74,30 +81,24 @@ def test_no_push_when_other_side_prunes():
 
 
 def test_filter_conjuncts_sink_to_join_sides():
-    from ballista_tpu.optimizer import push_filters
-
     a, b = _scan("a", ["ak", "x"]), _scan("b", ["bk", "y"])
     inner = Join(a, b, on=[("ak", "bk")], how="inner")
     pred = ((col("x") > lit(1)) & (col("y") > lit(2))
             & (col("x") < col("y")))
     out = push_filters(Filter(pred, inner))
-    from ballista_tpu import expr as ex
-
     # cross-side conjunct (references both inputs) stays above the join
     assert isinstance(out, Filter)
     assert set(ex.referenced_columns(out.predicate)) == {"x", "y"}
     j = out.input
     assert isinstance(j, Join)
-    # ...single-side conjuncts sank to their input
-    assert isinstance(j.left, Filter) and j.left.predicate.name().find("x") >= 0
-    assert isinstance(j.right, Filter) and j.right.predicate.name().find("y") >= 0
+    # single-side conjuncts sank to exactly their own input, undoubled
+    assert isinstance(j.left, Filter)
+    assert set(ex.referenced_columns(j.left.predicate)) == {"x"}
+    assert isinstance(j.right, Filter)
+    assert set(ex.referenced_columns(j.right.predicate)) == {"y"}
 
 
 def test_prune_columns_reaches_scans():
-    from ballista_tpu.logical import Projection
-    from ballista_tpu.optimizer import prune_columns
-    from ballista_tpu import expr as ex
-
     a, b = _scan("a", ["ak", "x", "unused1"]), _scan("b", ["bk", "y", "unused2"])
     inner = Join(a, b, on=[("ak", "bk")], how="inner")
     plan = Projection([ex.ColumnRef("x"), ex.ColumnRef("y")], inner)
