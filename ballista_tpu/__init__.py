@@ -39,9 +39,12 @@ if "ARROW_DEFAULT_MEMORY_POOL" not in _os.environ:
             pass
     else:
         _os.environ["ARROW_DEFAULT_MEMORY_POOL"] = "jemalloc"
-        # mark the choice as OURS: io/ipc.py's runtime fallback must not
-        # override a pool the USER explicitly selected
-        _os.environ["_BALLISTA_SET_ARROW_POOL"] = "1"
+        # mark the choice as OURS by recording the VALUE we set:
+        # io/ipc.py's runtime fallback must not override a pool the USER
+        # explicitly selected, and child processes inherit this marker —
+        # so it only counts as ours while ARROW_DEFAULT_MEMORY_POOL still
+        # equals what we wrote (a user override in the child wins)
+        _os.environ["_BALLISTA_SET_ARROW_POOL"] = "jemalloc"
 
 # Exact decimal arithmetic uses scaled int64 columns; without x64, JAX would
 # silently downcast them to int32. Float64 device arrays are never created

@@ -59,6 +59,14 @@ class BallistaContext:
         # in standalone mode, compiled) DataFrame; invalidated on any
         # catalog change
         self._plan_cache: Dict[str, "DataFrame"] = {}
+        # per-stage operator metrics of the last executed query
+        # (observability subsystem); None until a query completes or when
+        # metrics are disabled (BALLISTA_METRICS=0). Standalone queries
+        # stash the executed plan and snapshot LAZILY at read time —
+        # harvesting inside collect() would put a device_get + plan walk
+        # on every query's critical path (the < 5% overhead gate)
+        self._last_query_metrics = None
+        self._last_query_phys = None
 
     # -- constructors -------------------------------------------------------
 
@@ -202,13 +210,62 @@ class BallistaContext:
 
     def _collect(self, plan: LogicalPlan):
         if self.mode == "standalone":
-            from .execution import collect
-            from .physical.planner import PlannerOptions
-
-            return collect(plan, PlannerOptions.from_settings(self.settings))
+            out, _ = self._standalone_collect(plan)
+            return out
         from .distributed.client import remote_collect
 
-        return remote_collect(self.host, self.port, plan, self.settings)
+        sink: list = []
+        out = remote_collect(self.host, self.port, plan, self.settings,
+                             metrics_out=sink)
+        self._last_query_metrics = sink[0] if sink else None
+        self._last_query_phys = None
+        return out
+
+    def _standalone_collect(self, plan: LogicalPlan, phys=None):
+        """Shared standalone execute-and-wrap: plan (unless the caller
+        passes a cached physical plan), execute, record metrics.
+        Returns ``(frame, phys)`` so DataFrame.collect can keep its
+        plan cache."""
+        import pandas as pd
+
+        from .execution import collect_physical, plan_logical
+        from .observability.metrics import (metrics_enabled,
+                                            reset_plan_metrics)
+        from .physical.planner import PlannerOptions
+
+        if phys is None:
+            phys = plan_logical(plan,
+                                PlannerOptions.from_settings(self.settings))
+        if metrics_enabled():
+            # cached plans re-execute: last_query_metrics() must report
+            # THIS query, not the lifetime accumulation — and the reset
+            # drains pending device row-count scalars, which would
+            # otherwise grow unboundedly when metrics are never read
+            reset_plan_metrics(phys)
+        out = pd.DataFrame(collect_physical(phys))
+        self._record_plan_metrics(phys)
+        return out, phys
+
+    def _record_plan_metrics(self, phys) -> None:
+        from .observability.metrics import metrics_enabled
+
+        self._last_query_metrics = None
+        self._last_query_phys = phys if metrics_enabled() else None
+
+    def last_query_metrics(self):
+        """Per-stage/operator metric breakdown of the most recent query
+        this context executed (:class:`observability.QueryMetrics`), or
+        None before any query / under ``BALLISTA_METRICS=0``. Standalone
+        queries report a single synthetic stage 0; distributed queries
+        report the scheduler's per-stage aggregation over completed
+        tasks."""
+        if self._last_query_metrics is None and \
+                self._last_query_phys is not None:
+            from .observability.metrics import snapshot_plan_metrics
+
+            self._last_query_metrics = snapshot_plan_metrics(
+                self._last_query_phys)
+        return self._last_query_metrics
 
 
 def _is_ddl(query: str) -> bool:
@@ -254,6 +311,17 @@ class DataFrame:
             "== Logical plan ==\n" + self.plan.pretty()
             + "== Optimized ==\n" + optimize(self.plan).pretty()
         )
+
+    def explain_analyze(self) -> str:
+        """Execute the frame's plan and return the physical plan text
+        annotated with live operator metrics — the DataFrame face of SQL
+        ``EXPLAIN ANALYZE`` (works in standalone and remote mode; the
+        remote plan ships as one task, see physical/explain.py)."""
+        from .logical import Explain
+
+        out = self._with(Explain(self.plan, analyze=True)).collect()
+        rows = dict(zip(out["plan_type"], out["plan"]))
+        return rows.get("plan_with_metrics", "")
 
     def logical_plan(self) -> LogicalPlan:
         return self.plan
@@ -307,22 +375,18 @@ class DataFrame:
         if self._raw_sql is not None:
             from .distributed.client import remote_sql_collect
 
-            return remote_sql_collect(
+            sink: list = []
+            out = remote_sql_collect(
                 self.ctx.host, self.ctx.port, self._raw_sql,
-                self.ctx._catalog, self.ctx.settings,
+                self.ctx._catalog, self.ctx.settings, metrics_out=sink,
             )
+            self.ctx._last_query_metrics = sink[0] if sink else None
+            self.ctx._last_query_phys = None
+            return out
         if self.ctx.mode == "standalone":
-            import pandas as pd
-
-            from .execution import collect_physical, plan_logical
-
-            if self._phys is None:
-                from .physical.planner import PlannerOptions
-
-                self._phys = plan_logical(
-                    self.plan, PlannerOptions.from_settings(self.ctx.settings)
-                )
-            return pd.DataFrame(collect_physical(self._phys))
+            out, self._phys = self.ctx._standalone_collect(
+                self.plan, phys=self._phys)
+            return out
         return self.ctx._collect(self.plan)
 
     def to_pandas(self):

@@ -135,25 +135,42 @@ def _job_timeout(settings: Optional[Dict[str, str]],
 
 def remote_collect(host: str, port: int, logical_plan,
                    settings: Optional[Dict[str, str]] = None,
-                   timeout: Optional[float] = None):
-    """Submit + poll + fetch -> pandas DataFrame."""
+                   timeout: Optional[float] = None,
+                   metrics_out: Optional[list] = None):
+    """Submit + poll + fetch -> pandas DataFrame. ``metrics_out``
+    (when a list) receives the job's per-stage QueryMetrics, which ride
+    the completed JobStatus (ctx.last_query_metrics())."""
     from ..execution import resolve_scalar_subqueries
 
     deadline = _job_timeout(settings, timeout)  # fail fast pre-submit
     logical_plan = resolve_scalar_subqueries(logical_plan)
     job_id = submit_plan(host, port, logical_plan, settings)
     result = wait_for_job(host, port, job_id, deadline)
+    _deliver_metrics(result, metrics_out)
     return _fetch_result_frames(result)
 
 
 def remote_sql_collect(host: str, port: int, sql: str, catalog,
                        settings: Optional[Dict[str, str]] = None,
-                       timeout: Optional[float] = None):
+                       timeout: Optional[float] = None,
+                       metrics_out: Optional[list] = None):
     """Raw-SQL round trip: submit SQL + catalog, poll, fetch."""
     deadline = _job_timeout(settings, timeout)  # fail fast pre-submit
     job_id = submit_sql(host, port, sql, catalog, settings)
     result = wait_for_job(host, port, job_id, deadline)
+    _deliver_metrics(result, metrics_out)
     return _fetch_result_frames(result)
+
+
+def _deliver_metrics(result: pb.GetJobStatusResult,
+                     metrics_out: Optional[list]) -> None:
+    if metrics_out is None:
+        return
+    sm = result.status.completed.stage_metrics
+    if sm:
+        from ..observability.metrics import QueryMetrics
+
+        metrics_out.append(QueryMetrics(serde.stage_metrics_from_proto(sm)))
 
 
 def _fetch_result_frames(result: pb.GetJobStatusResult):

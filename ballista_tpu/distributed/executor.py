@@ -22,6 +22,8 @@ import uuid
 from concurrent import futures
 from typing import Optional
 
+from ..observability import trace_span
+from ..observability.metrics import collect_plan_metrics, metrics_enabled
 from ..proto import ballista_pb2 as pb
 from .. import serde
 from .dataplane import partition_path, start_data_plane
@@ -154,17 +156,19 @@ class Executor:
 
         def work():
             try:
-                if self.mesh_group is not None and _needs_mesh(plan):
-                    # group task: broadcast so every member process
-                    # enters the SPMD program together; serialized (the
-                    # collectives must align across processes)
-                    with self.mesh_group.lock:
-                        seq = self.mesh_group.broadcast(
-                            td.SerializeToString())
+                with trace_span("executor.task", task=pid.key(),
+                                executor=self.id[:8]):
+                    if self.mesh_group is not None and _needs_mesh(plan):
+                        # group task: broadcast so every member process
+                        # enters the SPMD program together; serialized (the
+                        # collectives must align across processes)
+                        with self.mesh_group.lock:
+                            seq = self.mesh_group.broadcast(
+                                td.SerializeToString())
+                            stats = self.execute_partition(pid, plan, shuffle)
+                            self.mesh_group.wait_acks(seq)
+                    else:
                         stats = self.execute_partition(pid, plan, shuffle)
-                        self.mesh_group.wait_acks(seq)
-                else:
-                    stats = self.execute_partition(pid, plan, shuffle)
                 self._report_completed(pid, stats)
             except Exception as e:  # noqa: BLE001 - task failure
                 log.exception("task %s failed", pid)
@@ -188,19 +192,49 @@ class Executor:
         t0 = time.time()
         batches = list(plan.execute(pid.partition_id))
         if shuffle is not None:
-            return self._write_shuffled(pid, plan, batches, shuffle, t0)
+            stats = self._write_shuffled(pid, plan, batches, shuffle, t0)
+            stats["task_metrics"] = self._harvest_metrics(
+                plan, time.time() - t0, stats, shuffled=True)
+            return stats
         path = partition_path(self.config.work_dir, pid.job_id, pid.stage_id,
                               pid.partition_id)
-        if batches:
-            stats = ipc.write_partition(path, batches)
-        else:
-            # empty partition: write an empty file with the plan schema
-            from ..columnar import empty_batch
+        tw = time.time()
+        with trace_span("dataplane.write", path=path):
+            if batches:
+                stats = ipc.write_partition(path, batches)
+            else:
+                # empty partition: write an empty file with the plan schema
+                from ..columnar import empty_batch
 
-            stats = ipc.write_partition(path, [empty_batch(plan.output_schema())])
+                stats = ipc.write_partition(
+                    path, [empty_batch(plan.output_schema())])
         log.info("executed %s in %.1fs (%d rows)", pid.key(),
                  time.time() - t0, stats["num_rows"])
-        return {**stats, "path": path}
+        out = {**stats, "path": path}
+        out["task_metrics"] = self._harvest_metrics(
+            plan, time.time() - t0, stats, write_secs=time.time() - tw)
+        return out
+
+    def _harvest_metrics(self, plan, elapsed_total: float, stats: dict,
+                         shuffled: bool = False,
+                         write_secs: float = 0.0) -> "dict | None":
+        """Per-operator metrics off the executed plan + a synthetic
+        write-side row (shuffle/partition IPC write happens outside the
+        plan, so bytes_written needs its own operator row; its position
+        is stable across tasks of a stage, keeping positional stage
+        aggregation valid)."""
+        if not metrics_enabled():
+            return None
+        ops = collect_plan_metrics(plan)
+        write_row = {
+            "operator": "ShuffleWrite" if shuffled else "PartitionWrite",
+            "depth": 0,
+            "metrics": {"bytes_written": int(stats.get("num_bytes", 0))},
+        }
+        if write_secs:
+            write_row["metrics"]["elapsed_write"] = write_secs
+        ops.append(write_row)
+        return {"operators": ops, "elapsed_total": elapsed_total}
 
     def _write_shuffled(self, pid: PartitionId, plan, batches, shuffle,
                         t0: float) -> dict:
@@ -229,14 +263,15 @@ class Executor:
                 )
             offset += b.num_rows_host()
         base = None
-        for q in range(n_out):
-            path = shuffle_path(self.config.work_dir, pid.job_id,
-                                pid.stage_id, pid.partition_id, q)
-            base = path
-            st = ipc.write_partition(path, masked[q],
-                                     compute_column_stats=False)
-            for k in totals:
-                totals[k] += st[k]
+        with trace_span("dataplane.write", task=pid.key(), fan_out=n_out):
+            for q in range(n_out):
+                path = shuffle_path(self.config.work_dir, pid.job_id,
+                                    pid.stage_id, pid.partition_id, q)
+                base = path
+                st = ipc.write_partition(path, masked[q],
+                                         compute_column_stats=False)
+                for k in totals:
+                    totals[k] += st[k]
         log.info("executed %s (shuffle x%d) in %.1fs (%d rows)", pid.key(),
                  n_out, time.time() - t0, totals["num_rows"])
         return {**totals, "path": base}
@@ -248,6 +283,9 @@ class Executor:
         ts.partition_id.partition_id = pid.partition_id
         ts.completed.executor_id = self.id
         ts.completed.path = stats["path"]
+        tm = stats.get("task_metrics")
+        if tm:
+            serde.task_metrics_to_proto(tm, ts.completed.metrics)
         serde.stats_to_proto(stats, ts.completed.stats)
         with self._status_lock:
             self._pending_status.append(ts)

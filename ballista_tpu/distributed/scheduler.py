@@ -24,6 +24,7 @@ import grpc
 
 from ..errors import ClusterError
 from ..execution import plan_logical
+from ..observability import trace_event, trace_span
 from ..proto import ballista_pb2 as pb
 from .. import serde
 from .planner import (
@@ -276,49 +277,55 @@ class SchedulerService:
     def _plan_job(self, job_id: str, logical_plan, settings=None,
                   sql=None, catalog_entries=None):
         try:
-            from ..physical.planner import PlannerOptions
-
-            t0 = time.time()
-            if logical_plan is None:
-                logical_plan = self._plan_sql(sql, catalog_entries or [])
-            phys = plan_logical(logical_plan,
-                                PlannerOptions.from_settings(settings))
-            stages = DistributedPlanner().plan_query_stages(job_id, phys)
-            stages = _fuse_mesh_stages(
-                stages, _cluster_mesh_devices(self.state, settings)
-            )
-            for stage in stages:
-                deps = [
-                    sid
-                    for u in find_unresolved_shuffles(stage.child)
-                    for sid in u.query_stage_ids
-                ]
-                nparts = stage.output_partitioning().num_partitions
-                plan_bytes = serde.physical_to_proto(stage.child).SerializeToString()
-                shuffle_spec = None
-                if stage.shuffle_output_partitions:
-                    hx = [
-                        serde.expr_to_proto(e).SerializeToString()
-                        for e in (stage.shuffle_hash_exprs or [])
-                    ]
-                    shuffle_spec = (hx, stage.shuffle_output_partitions)
-                self.state.save_stage_plan(
-                    job_id, stage.stage_id, plan_bytes, nparts, deps,
-                    shuffle_spec,
-                    mesh_devices=_mesh_requirement(stage.child),
-                )
-                for p in range(nparts):
-                    self.state.save_task_status(
-                        TaskStatus(PartitionId(job_id, stage.stage_id, p))
-                    )
-            self.state.enqueue_job(job_id)
-            log.info(
-                "planned job %s into %d stages in %.0fms",
-                job_id, len(stages), 1000 * (time.time() - t0),
-            )
+            with trace_span("scheduler.plan_job", job=job_id):
+                self._plan_job_inner(job_id, logical_plan, settings, sql,
+                                     catalog_entries)
         except Exception as e:  # noqa: BLE001 - job-level failure
             log.exception("planning failed for job %s", job_id)
             self.state.save_job_status(job_id, JobStatus("failed", error=str(e)))
+
+    def _plan_job_inner(self, job_id: str, logical_plan, settings=None,
+                        sql=None, catalog_entries=None):
+        from ..physical.planner import PlannerOptions
+
+        t0 = time.time()
+        if logical_plan is None:
+            logical_plan = self._plan_sql(sql, catalog_entries or [])
+        phys = plan_logical(logical_plan,
+                            PlannerOptions.from_settings(settings))
+        stages = DistributedPlanner().plan_query_stages(job_id, phys)
+        stages = _fuse_mesh_stages(
+            stages, _cluster_mesh_devices(self.state, settings)
+        )
+        for stage in stages:
+            deps = [
+                sid
+                for u in find_unresolved_shuffles(stage.child)
+                for sid in u.query_stage_ids
+            ]
+            nparts = stage.output_partitioning().num_partitions
+            plan_bytes = serde.physical_to_proto(stage.child).SerializeToString()
+            shuffle_spec = None
+            if stage.shuffle_output_partitions:
+                hx = [
+                    serde.expr_to_proto(e).SerializeToString()
+                    for e in (stage.shuffle_hash_exprs or [])
+                ]
+                shuffle_spec = (hx, stage.shuffle_output_partitions)
+            self.state.save_stage_plan(
+                job_id, stage.stage_id, plan_bytes, nparts, deps,
+                shuffle_spec,
+                mesh_devices=_mesh_requirement(stage.child),
+            )
+            for p in range(nparts):
+                self.state.save_task_status(
+                    TaskStatus(PartitionId(job_id, stage.stage_id, p))
+                )
+        self.state.enqueue_job(job_id)
+        log.info(
+            "planned job %s into %d stages in %.0fms",
+            job_id, len(stages), 1000 * (time.time() - t0),
+        )
 
     # -- RPC: PollWork ------------------------------------------------------
 
@@ -374,6 +381,8 @@ class SchedulerService:
             if task is not None:
                 try:
                     result.task.CopyFrom(self._task_definition(task, meta))
+                    trace_event("scheduler.task_dispatch", task=task.key(),
+                                executor=meta.id[:8])
                 except Exception as e:  # noqa: BLE001
                     log.exception("task resolution failed for %s", task)
                     st = TaskStatus(task, "failed", error=str(e))
@@ -445,6 +454,10 @@ class SchedulerService:
                 result.status.completed.partition_location.append(
                     serde.location_to_proto(loc)
                 )
+            if getattr(st, "stage_metrics", None):
+                serde.stage_metrics_to_proto(
+                    st.stage_metrics, result.status.completed.stage_metrics
+                )
         return result
 
     # -- RPC: GetExecutorsMetadata ------------------------------------------
@@ -511,6 +524,7 @@ def _task_status_from_proto(ts: pb.TaskStatus) -> TaskStatus:
             pid, "completed", executor_id=ts.completed.executor_id,
             path=ts.completed.path,
             stats=serde.stats_from_proto(ts.completed.stats),
+            metrics=serde.task_metrics_from_proto(ts.completed.metrics),
         )
     return TaskStatus(pid)
 

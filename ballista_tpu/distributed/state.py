@@ -673,7 +673,33 @@ class SchedulerState:
                     )
                 return
             self.save_job_status(
-                job_id, JobStatus("completed", partition_locations=locs)
+                job_id,
+                JobStatus("completed", partition_locations=locs,
+                          stage_metrics=self._aggregate_stage_metrics(tasks)),
             )
         elif any(t.state is not None for t in tasks):
             self.save_job_status(job_id, JobStatus("running"))
+
+    def _aggregate_stage_metrics(self, tasks) -> Dict[int, dict]:
+        """Merge completed tasks' per-operator metrics per stage (tasks of
+        one stage share a plan shape, so operator rows align
+        positionally). Returned with the completed JobStatus so the
+        client's ``ctx.last_query_metrics()`` gets a per-stage breakdown
+        without extra RPCs."""
+        from ..observability.metrics import merge_operator_metrics
+
+        by_stage: Dict[int, List] = {}
+        for t in tasks:
+            tm = getattr(t, "metrics", None)
+            if t.state == "completed" and tm:
+                by_stage.setdefault(t.partition.stage_id, []).append(tm)
+        out: Dict[int, dict] = {}
+        for sid, tms in by_stage.items():
+            out[sid] = {
+                "num_tasks": len(tms),
+                "elapsed_total": sum(tm.get("elapsed_total", 0.0)
+                                     for tm in tms),
+                "operators": merge_operator_metrics(
+                    tm.get("operators") or [] for tm in tms),
+            }
+        return out

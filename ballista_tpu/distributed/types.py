@@ -50,6 +50,9 @@ class TaskStatus:
     stats: Optional[Dict[str, int]] = None
     # assignment wall time; drives straggler detection (speculation)
     started_at: Optional[float] = None
+    # per-operator execution metrics shipped with completion
+    # ({"operators": [...], "elapsed_total": float}; see observability)
+    metrics: Optional[dict] = None
 
 
 @dataclass
@@ -57,3 +60,5 @@ class JobStatus:
     state: str  # queued|running|completed|failed
     error: Optional[str] = None
     partition_locations: Optional[list] = None
+    # stage_id -> aggregated task metrics (filled when completed)
+    stage_metrics: Optional[dict] = None

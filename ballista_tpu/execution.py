@@ -106,13 +106,20 @@ def plan_logical(plan: LogicalPlan, options=None) -> PhysicalPlan:
         # render before AND after optimization so EXPLAIN VERBOSE can show
         # what the optimizer did; the rows execute as a normal leaf node
         # (distributed: the text rides the standard shuffle/fetch path)
-        from .physical.explain import render_explain
+        from .physical.explain import ExplainAnalyzeExec, render_explain
 
         inner = resolve_scalar_subqueries(plan.input, options)
         unopt = inner.pretty()
         opt = optimize(inner)
-        return render_explain(opt, create_physical_plan(opt, options),
-                              plan.verbose, unoptimized_text=unopt)
+        phys = create_physical_plan(opt, options)
+        if plan.analyze:
+            # EXPLAIN ANALYZE: execute the plan and annotate it with live
+            # metrics; the node is a leaf, so distributed runs ship the
+            # whole analyzed plan as one task (observability docs)
+            return ExplainAnalyzeExec(phys, plan.verbose,
+                                      logical_text=opt.pretty())
+        return render_explain(opt, phys, plan.verbose,
+                              unoptimized_text=unopt)
     plan = resolve_scalar_subqueries(plan, options)
     return create_physical_plan(optimize(plan), options)
 

@@ -44,8 +44,15 @@ def _arrow():
         # verify at first use and degrade to the system allocator — but
         # only when the pool choice was OURS: a user's explicit
         # ARROW_DEFAULT_MEMORY_POOL always wins.
-        user_chose = ("ARROW_DEFAULT_MEMORY_POOL" in os.environ
-                      and not os.environ.get("_BALLISTA_SET_ARROW_POOL"))
+        # ours only when the env still holds the exact value we recorded
+        # at set time: the marker is inherited by child processes, where
+        # a user's explicit ARROW_DEFAULT_MEMORY_POOL must win even
+        # though the marker is present
+        user_chose = (
+            "ARROW_DEFAULT_MEMORY_POOL" in os.environ
+            and os.environ["ARROW_DEFAULT_MEMORY_POOL"]
+            != os.environ.get("_BALLISTA_SET_ARROW_POOL")
+        )
         try:
             if (not user_chose
                     and pa.default_memory_pool().backend_name == "mimalloc"
@@ -190,6 +197,23 @@ def _norm_stat(v):
     return v
 
 
+def decode_fixed_size_list(chunk) -> np.ndarray:
+    """FixedSizeListArray chunk -> (rows, width) ndarray of flat values.
+
+    ``.values`` spans all slots (incl. null rows), so the reshape stays
+    aligned with the row axis — but it ignores a slice offset on the
+    chunk (an Arrow slice adjusts offset/length only, the child stays
+    whole), so slice the flat child to this chunk's window first.
+    In-repo IPC files always arrive unsliced (serialization materializes
+    slices); the offset handling protects direct/zero-copy producers.
+    """
+    width = chunk.type.list_size
+    flat = chunk.values.to_numpy(zero_copy_only=False)
+    off = chunk.offset
+    flat = flat[off * width:(off + len(chunk)) * width]
+    return flat.reshape(len(chunk), width)
+
+
 def read_partition_arrays(
     path_or_buf,
 ) -> Tuple[List[str], Dict[str, np.ndarray], Dict[str, np.ndarray],
@@ -225,11 +249,7 @@ def read_partition_arrays(
             kinds[name] = ("utf8", 0)
         elif pa.types.is_fixed_size_list(chunk.type):
             null_mask = np.asarray(chunk.is_null())
-            width = chunk.type.list_size
-            # .values spans all slots (incl. null rows), so the reshape
-            # stays aligned with the row axis
-            flat = chunk.values.to_numpy(zero_copy_only=False)
-            arrays[name] = flat.reshape(len(chunk), width)
+            arrays[name] = decode_fixed_size_list(chunk)
             ekind = (meta.get(b"ballista.element_kind", b"").decode()
                      or str(chunk.type.value_type))
             escale = int(meta.get(b"ballista.element_scale", b"0") or 0)

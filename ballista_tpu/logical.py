@@ -270,6 +270,9 @@ EXPLAIN_SCHEMA = _explain_schema()
 class Explain(LogicalPlan):
     input: LogicalPlan
     verbose: bool = False
+    # EXPLAIN ANALYZE: execute the input and annotate the rendered
+    # physical plan with live operator metrics
+    analyze: bool = False
 
     def schema(self) -> Schema:
         return EXPLAIN_SCHEMA
@@ -278,7 +281,8 @@ class Explain(LogicalPlan):
         return [self.input]
 
     def display(self) -> str:
-        return "Explain" + (" verbose" if self.verbose else "")
+        return ("Explain" + (" analyze" if self.analyze else "")
+                + (" verbose" if self.verbose else ""))
 
 
 # ---------------------------------------------------------------------------

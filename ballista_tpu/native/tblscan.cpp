@@ -218,6 +218,7 @@ bool parse_span(Table* t, const char* data, const char* end,
       ++p;
       continue;
     }
+    const char* row_start = p;
     for (int ci = 0; ci < ncols; ++ci) {
       const char* fe = static_cast<const char*>(
           memchr(p, delim, static_cast<size_t>(nl - p)));
@@ -225,10 +226,17 @@ bool parse_span(Table* t, const char* data, const char* end,
       Column& c = t->cols[static_cast<size_t>(ci)];
       if (c.kind >= 0) {
         if (!parse_field(c, p, fe)) {
-          char msg[160];
+          // `row` counts from the span start, which is meaningless to a
+          // reader of a ranged/multithreaded scan; the absolute byte
+          // offsets of the failing row and of the span locate the error
+          // in the file regardless of which sub-span hit it
+          char msg[224];
           snprintf(msg, sizeof msg,
-                   "parse error at row %lld col %d (kind %d)",
-                   static_cast<long long>(row), ci, c.kind);
+                   "parse error at row %lld of span (row byte offset "
+                   "%lld, span starts at byte %lld) col %d (kind %d)",
+                   static_cast<long long>(row),
+                   static_cast<long long>(row_start - data),
+                   static_cast<long long>(from - data), ci, c.kind);
           t->error = msg;
           return false;
         }

@@ -1,0 +1,23 @@
+"""Observability: operator metrics, EXPLAIN ANALYZE plumbing, tracing.
+
+The measurement substrate the reference engine never grew (its
+PartitionStats proto is declared but unpopulated, and DataFusion-side
+operator metrics never cross the Ballista wire): every PhysicalPlan
+carries a lock-cheap ``MetricsSet``; executors ship per-task metrics back
+with task completion; the scheduler aggregates them per stage; and a
+span-style tracer (``BALLISTA_TRACE=1``) writes JSON-lines trace files
+covering scheduler events, task dispatch, shuffle fetch, and dataplane
+I/O.
+"""
+
+from .metrics import (  # noqa: F401
+    MetricsSet,
+    QueryMetrics,
+    collect_plan_metrics,
+    force_metrics,
+    instrument_execute,
+    merge_operator_metrics,
+    metrics_enabled,
+    snapshot_plan_metrics,
+)
+from .tracing import trace_enabled, trace_event, trace_span  # noqa: F401

@@ -1,0 +1,127 @@
+"""Span-style tracing: ``BALLISTA_TRACE=1`` -> JSON-lines trace file per
+process.
+
+Coverage (each site tags its span name with the subsystem): scheduler
+events (``scheduler.plan_job``, ``scheduler.task_dispatch``), executor
+task execution (``executor.task``), shuffle fetch (``shuffle.fetch``),
+and dataplane I/O (``dataplane.write``). A span line is::
+
+    {"name": ..., "ts": <epoch start>, "dur": <seconds>, "pid": ...,
+     "tid": ..., <attrs>}
+
+Instant events carry no ``dur``. Files land in ``BALLISTA_TRACE_DIR``
+(default: the system temp dir) as ``ballista-trace-<pid>.jsonl`` so a
+multi-process cluster writes one file per scheduler/executor process
+with no cross-process locking; ``BALLISTA_TRACE_FILE`` pins an exact
+path instead. Writes are line-buffered under a process-local lock —
+tracing is for diagnosis runs, not the steady-state hot path, and the
+disabled path is a single cached boolean check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Optional
+
+_lock = threading.Lock()
+_state: dict = {"configured": False, "fh": None}
+
+
+def _configure_locked() -> None:
+    _state["configured"] = True
+    if os.environ.get("BALLISTA_TRACE", "").lower() not in ("1", "on",
+                                                            "true"):
+        _state["fh"] = None
+        return
+    path = os.environ.get("BALLISTA_TRACE_FILE")
+    if not path:
+        trace_dir = os.environ.get("BALLISTA_TRACE_DIR",
+                                   tempfile.gettempdir())
+        path = os.path.join(trace_dir, f"ballista-trace-{os.getpid()}.jsonl")
+    try:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        _state["fh"] = open(path, "a", buffering=1)
+        _state["path"] = path
+    except OSError:
+        _state["fh"] = None
+
+
+def _fh():
+    if not _state["configured"]:
+        with _lock:
+            if not _state["configured"]:
+                _configure_locked()
+    return _state["fh"]
+
+
+def trace_enabled() -> bool:
+    return _fh() is not None
+
+
+def trace_path() -> Optional[str]:
+    return _state.get("path") if _fh() is not None else None
+
+
+def reconfigure() -> None:
+    """Re-read the BALLISTA_TRACE* env (tests flip it mid-process; a
+    forked executor inherits env and configures itself on first use)."""
+    with _lock:
+        fh = _state.get("fh")
+        if fh is not None:
+            try:
+                fh.close()
+            except OSError:
+                pass
+        _state.clear()
+        _state.update({"configured": False, "fh": None})
+
+
+def _emit(record: dict) -> None:
+    fh = _fh()
+    if fh is None:
+        return
+    line = json.dumps(record, default=str)
+    with _lock:
+        try:
+            fh.write(line + "\n")
+        except (OSError, ValueError):  # closed/full: drop, never raise
+            pass
+
+
+def trace_event(name: str, **attrs) -> None:
+    """Instant event (no duration)."""
+    if _fh() is None:
+        return
+    _emit({"name": name, "ts": time.time(),
+           "pid": os.getpid(), "tid": threading.get_ident(), **attrs})
+
+
+class trace_span:
+    """``with trace_span("executor.task", task=key): ...`` — records one
+    line with the span's start time and duration (exceptions are noted
+    as ``error=<ExcType>`` and re-raised)."""
+
+    __slots__ = ("name", "attrs", "_t0")
+
+    def __init__(self, name: str, **attrs):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._t0 = time.time() if _fh() is not None else None
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._t0 is not None:
+            rec = {"name": self.name, "ts": self._t0,
+                   "dur": time.time() - self._t0,
+                   "pid": os.getpid(), "tid": threading.get_ident(),
+                   **self.attrs}
+            if exc_type is not None:
+                rec["error"] = exc_type.__name__
+            _emit(rec)
+        return False

@@ -255,7 +255,7 @@ def push_filters(plan: LogicalPlan) -> LogicalPlan:
         return dataclasses.replace(plan, left=push_filters(plan.left),
                                    right=push_filters(plan.right))
     if isinstance(plan, Explain):
-        return Explain(push_filters(plan.input), plan.verbose)
+        return Explain(push_filters(plan.input), plan.verbose, plan.analyze)
     return plan
 
 
@@ -344,5 +344,6 @@ def prune_columns(plan: LogicalPlan, required: Optional[Set[str]]) -> LogicalPla
                                    left=prune_columns(plan.left, lneed),
                                    right=prune_columns(plan.right, rneed))
     if isinstance(plan, Explain):
-        return Explain(prune_columns(plan.input, None), plan.verbose)
+        return Explain(prune_columns(plan.input, None), plan.verbose,
+                       plan.analyze)
     return plan

@@ -21,6 +21,7 @@ import numpy as np
 from ..columnar import Column, ColumnBatch
 from ..datatypes import Schema
 from ..errors import ExecutionError
+from ..observability.metrics import MetricsSet, instrument_execute
 
 
 @dataclass(frozen=True)
@@ -33,7 +34,31 @@ class Partitioning:
 
 
 class PhysicalPlan:
-    """Base physical operator."""
+    """Base physical operator.
+
+    Every subclass that overrides ``execute`` is transparently
+    instrumented (``__init_subclass__`` below): each call records
+    ``output_rows``/``output_batches``/``elapsed_compute`` on the
+    operator's :class:`MetricsSet` with zero per-operator boilerplate.
+    Operators add their own domain counters (compaction, shuffle bytes,
+    expand re-runs) via ``self.metrics()``.
+    """
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        exec_fn = cls.__dict__.get("execute")
+        if exec_fn is not None:
+            cls.execute = instrument_execute(exec_fn)
+
+    def metrics(self) -> MetricsSet:
+        """The operator's MetricsSet (lazily created). Plain instance
+        state, same benign-race policy as the adaptive counters below:
+        concurrent partition execution can interleave updates and lose
+        an increment, which skews a displayed number, never a result."""
+        m = getattr(self, "_metrics", None)
+        if m is None:
+            m = self._metrics = MetricsSet()
+        return m
 
     def output_schema(self) -> Schema:
         raise NotImplementedError
@@ -74,6 +99,17 @@ class PhysicalPlan:
             out += c.pretty(indent + 1)
         return out
 
+    def pretty_metrics(self, indent: int = 0) -> str:
+        """Plan text annotated with live metrics (EXPLAIN ANALYZE).
+        Operators fused into a pipeline chain show no numbers of their
+        own — the chain's totals sit on its outermost operator."""
+        ann = self.metrics().summary()
+        out = ("  " * indent + self.display()
+               + (f", metrics=[{ann}]" if ann else "") + "\n")
+        for c in self.children():
+            out += c.pretty_metrics(indent + 1)
+        return out
+
 
 class PipelineOp(PhysicalPlan):
     """Operator whose work is a pure batch->batch device transform.
@@ -110,8 +146,11 @@ class PipelineOp(PhysicalPlan):
         return chain, node
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        import time as _time
+
         chain, source = self._pipeline_chain()
         fused = getattr(self, "_fused_fn", None)
+        first_call = False
         if fused is None:
 
             def apply_all(batch):
@@ -121,6 +160,7 @@ class PipelineOp(PhysicalPlan):
 
             fused = jax.jit(apply_all)
             self._fused_fn = fused
+            first_call = True
         # Adaptive: a filter's selectivity is stationary within a query,
         # so after 2 consecutive batches that decline to compact, stop
         # paying the per-batch live-count sync for the operator's
@@ -129,9 +169,28 @@ class PipelineOp(PhysicalPlan):
         # unselective filters). The learned capacity floor keeps later
         # batches from compacting to ever-different power-of-two rungs,
         # bounding downstream per-capacity jit compiles to ~one extra.
+        #
+        # BENIGN RACE: _compact_misses/_compact_floor (and JoinExec's
+        # _expand_cap_floor) are unsynchronized instance state mutated
+        # here; executor worker threads running partitions of one
+        # operator concurrently can interleave updates. Outcomes stay
+        # correct — these only steer heuristics — but learned values can
+        # thrash; the same policy covers the MetricsSet counters below.
         compact = any(op.compactable for op in chain)
         for batch in source.execute(partition):
-            out = fused(batch)
+            if first_call:
+                # first fused call pays the XLA compile; record it as
+                # the operator's compile-vs-execute split (upper bound:
+                # the measurement includes the first batch's execution,
+                # but compile dominates by orders of magnitude when the
+                # persistent XLA cache misses)
+                t0 = _time.perf_counter()
+                out = fused(batch)
+                self.metrics().add_time("elapsed_compile",
+                                        _time.perf_counter() - t0)
+                first_call = False
+            else:
+                out = fused(batch)
             if compact and getattr(self, "_compact_misses", 0) < 2:
                 res = maybe_compact(
                     out, floor=getattr(self, "_compact_floor", 8))
@@ -142,6 +201,7 @@ class PipelineOp(PhysicalPlan):
                     self._compact_misses = 0
                     self._compact_floor = max(
                         getattr(self, "_compact_floor", 8), res.capacity)
+                    self.metrics().add_counter("compact_count")
                 out = res
             yield out
 

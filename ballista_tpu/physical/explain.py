@@ -52,6 +52,76 @@ class ExplainExec(PhysicalPlan):
         return f"ExplainExec rows={len(self.rows)}"
 
 
+class ExplainAnalyzeExec(PhysicalPlan):
+    """EXPLAIN ANALYZE: execute the inner plan, drain its output, and
+    yield the plan text annotated with live operator metrics.
+
+    Presents as a LEAF (``children() == []``) on purpose: the
+    distributed planner then never splits the inner plan into stages, so
+    the whole analyzed query runs as ONE task on one executor and the
+    annotated rows ride the existing single-partition result channel —
+    the same trick ExplainExec uses for plain EXPLAIN. Metrics are
+    force-enabled around the run, so ANALYZE measures even under
+    BALLISTA_METRICS=0.
+    """
+
+    def __init__(self, inner: PhysicalPlan, verbose: bool = False,
+                 logical_text: str | None = None):
+        self.inner = inner
+        self.verbose = verbose
+        self.logical_text = logical_text
+
+    def output_schema(self) -> Schema:
+        return EXPLAIN_SCHEMA
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning("unknown", 1)
+
+    def children(self) -> List[PhysicalPlan]:
+        return []  # leaf by design (see docstring)
+
+    def with_new_children(self, children) -> "ExplainAnalyzeExec":
+        return self
+
+    def estimated_rows(self):
+        return 2
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        import time as _time
+
+        from ..io.memory import MemTableSource
+        from ..observability.metrics import (force_metrics,
+                                             reset_plan_metrics,
+                                             resolve_plan_pending)
+
+        # the inner plan may be cached (standalone DataFrames reuse
+        # their physical plan across collects): report THIS run only
+        reset_plan_metrics(self.inner)
+        t0 = _time.perf_counter()
+        with force_metrics():
+            for p in range(self.inner.output_partitioning().num_partitions):
+                for _ in self.inner.execute(p):
+                    pass  # drain: ANALYZE reports metrics, not rows
+        total = _time.perf_counter() - t0
+        # one batched device_get for every operator's pending row counts
+        # (pretty_metrics would otherwise pay one transfer per operator)
+        resolve_plan_pending(self.inner)
+        rows: List[Tuple[str, str]] = []
+        if self.verbose and self.logical_text is not None:
+            rows.append(("logical_plan", self.logical_text))
+        rows.append(("plan_with_metrics", self.inner.pretty_metrics()))
+        rows.append(("total_elapsed", f"{total:.6f}s"))
+        src = MemTableSource.from_pydict(
+            EXPLAIN_SCHEMA,
+            {"plan_type": [t for t, _ in rows],
+             "plan": [p for _, p in rows]},
+        )
+        yield from src.scan(0)
+
+    def display(self) -> str:
+        return "ExplainAnalyzeExec"
+
+
 def render_explain(logical_input, physical_input: PhysicalPlan,
                    verbose: bool,
                    unoptimized_text: str | None = None) -> ExplainExec:

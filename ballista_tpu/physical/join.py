@@ -619,10 +619,14 @@ class JoinExec(PhysicalPlan):
         # multiplying its peak memory by the batch-count window
         window_bytes = int(_os.environ.get(
             "BALLISTA_JOIN_SYNC_WINDOW_BYTES", str(1 << 30)))
+        # fixed-size-list columns hold ``length`` elements per row, so
+        # itemsize alone would under-count them by length x
         row_bytes = sum(
-            f.dtype.device_dtype().itemsize
+            f.dtype.device_dtype().itemsize * (getattr(f.dtype, "length", 0)
+                                               or 1)
             for f in self.output_schema().fields
         ) + sum(f.dtype.device_dtype().itemsize
+                * (getattr(f.dtype, "length", 0) or 1)
                 for f in self.probe.output_schema().fields)
         pend: list = []
         pend_bytes = 0
@@ -636,6 +640,7 @@ class JoinExec(PhysicalPlan):
             for (pb, remaps, out, out_cap, _), total in zip(pend, totals):
                 t = int(total)
                 while t > out_cap:  # rare: re-run at the exact capacity
+                    self.metrics().add_counter("expand_reruns")
                     out_cap = round_capacity(t)
                     out, tot = self._expand_run(
                         table, build_batch, pb, mode, key_tables, remaps,

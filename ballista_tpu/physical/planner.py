@@ -233,8 +233,12 @@ def _create(plan: LogicalPlan, opts: PlannerOptions) -> PhysicalPlan:
     if isinstance(plan, Explain):
         # direct-call path (plan already optimized by the caller);
         # execution.plan_logical captures the pre-optimization text too
-        from .explain import render_explain
+        from .explain import ExplainAnalyzeExec, render_explain
 
+        if plan.analyze:
+            return ExplainAnalyzeExec(create_physical_plan(plan.input),
+                                      plan.verbose,
+                                      logical_text=plan.input.pretty())
         return render_explain(plan.input, create_physical_plan(plan.input),
                               plan.verbose)
 

@@ -155,12 +155,17 @@ class ShuffleReaderExec(PhysicalPlan):
             return self._cache[q]
         from ..io import ipc
 
+        m = self.metrics()
         parts = []
         for loc in self._groups[q]:
             if not self.FORCE_REMOTE and loc.path and os.path.exists(loc.path):
+                m.add_counter("bytes_read", os.path.getsize(loc.path))
+                m.add_counter("local_reads")
                 _, arrays, nulls, dicts, _ = ipc.read_partition_arrays(loc.path)
             else:
                 buf = self._fetch_with_retry(loc)
+                m.add_counter("bytes_read", len(buf))
+                m.add_counter("remote_fetches")
                 _, arrays, nulls, dicts, _ = ipc.read_partition_arrays(buf)
             parts.append((arrays, nulls, dicts))
         batches = ipc.batches_from_parts(self._schema, parts)
@@ -176,6 +181,7 @@ class ShuffleReaderExec(PhysicalPlan):
 
         from ..distributed.dataplane import fetch_partition_bytes
         from ..errors import ShuffleFetchError
+        from ..observability import trace_span
 
         if not loc.host or not loc.port:
             raise ShuffleFetchError(
@@ -187,11 +193,15 @@ class ShuffleReaderExec(PhysicalPlan):
             try:
                 # 10s covers connect and each recv (not the whole
                 # transfer); a dead-but-backlogged peer fails fast
-                return fetch_partition_bytes(
-                    loc.host, loc.port, loc.job_id, loc.stage_id,
-                    loc.partition_id, shuffle_output=loc.shuffle_output,
-                    timeout=10.0,
-                )
+                with trace_span("shuffle.fetch", host=loc.host,
+                                stage=loc.stage_id,
+                                partition=loc.partition_id,
+                                attempt=attempt):
+                    return fetch_partition_bytes(
+                        loc.host, loc.port, loc.job_id, loc.stage_id,
+                        loc.partition_id, shuffle_output=loc.shuffle_output,
+                        timeout=10.0,
+                    )
             except Exception as e:  # noqa: BLE001 - any transport failure
                 last = e
                 if attempt == 0:

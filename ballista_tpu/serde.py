@@ -304,6 +304,7 @@ def plan_to_proto(plan: lp.LogicalPlan) -> pb.LogicalPlanNode:
     elif isinstance(plan, lp.Explain):
         n.explain.input.CopyFrom(plan_to_proto(plan.input))
         n.explain.verbose = plan.verbose
+        n.explain.analyze = plan.analyze
     else:
         raise SerdeError(f"cannot serialize plan {type(plan).__name__}")
     return n
@@ -355,7 +356,8 @@ def plan_from_proto(n: pb.LogicalPlanNode) -> lp.LogicalPlan:
     if kind == "empty":
         return lp.EmptyRelation(n.empty.produce_one_row)
     if kind == "explain":
-        return lp.Explain(plan_from_proto(n.explain.input), n.explain.verbose)
+        return lp.Explain(plan_from_proto(n.explain.input), n.explain.verbose,
+                          n.explain.analyze)
     raise SerdeError(f"unknown plan node {kind}")
 
 
@@ -366,7 +368,7 @@ def plan_from_proto(n: pb.LogicalPlanNode) -> lp.LogicalPlan:
 
 def physical_to_proto(plan) -> pb.PhysicalPlanNode:
     from .physical.aggregate import HashAggregateExec
-    from .physical.explain import ExplainExec
+    from .physical.explain import ExplainAnalyzeExec, ExplainExec
     from .physical.join import JoinExec
     from .physical.mesh_agg import MeshAggExec, MeshJoinExec
     from .physical import operators as ops
@@ -455,6 +457,10 @@ def physical_to_proto(plan) -> pb.PhysicalPlanNode:
     elif isinstance(plan, ExplainExec):
         n.explain.plan_type.extend(t for t, _ in plan.rows)
         n.explain.plan.extend(p for _, p in plan.rows)
+    elif isinstance(plan, ExplainAnalyzeExec):
+        n.explain_analyze.input.CopyFrom(physical_to_proto(plan.inner))
+        n.explain_analyze.verbose = plan.verbose
+        n.explain_analyze.logical_text = plan.logical_text or ""
     else:
         raise SerdeError(f"cannot serialize physical plan {type(plan).__name__}")
     return n
@@ -558,6 +564,14 @@ def physical_from_proto(n: pb.PhysicalPlanNode):
         from .physical.explain import ExplainExec
 
         return ExplainExec(list(zip(n.explain.plan_type, n.explain.plan)))
+    if kind == "explain_analyze":
+        from .physical.explain import ExplainAnalyzeExec
+
+        return ExplainAnalyzeExec(
+            physical_from_proto(n.explain_analyze.input),
+            n.explain_analyze.verbose,
+            logical_text=n.explain_analyze.logical_text or None,
+        )
     raise SerdeError(f"unknown physical node {kind}")
 
 
@@ -648,3 +662,72 @@ def location_from_proto(p: pb.PartitionLocation):
         shuffle_output=p.shuffle_output if p.is_shuffle else None,
         stats=stats_from_proto(p.partition_stats),
     )
+
+
+# ---------------------------------------------------------------------------
+# Task/stage metrics (observability subsystem)
+# ---------------------------------------------------------------------------
+# Python shape: {"operators": [{"operator", "depth", "metrics": {...}}],
+# "elapsed_total": float}. Timer values keep their ``elapsed_`` name
+# prefix; the proto oneof preserves the kind across the wire.
+
+
+def task_metrics_to_proto(tm: dict, msg: "pb.TaskMetrics") -> None:
+    msg.elapsed_total_secs = float(tm.get("elapsed_total", 0.0))
+    for row in tm.get("operators") or []:
+        om = msg.operators.add()
+        om.operator = row.get("operator", "")
+        om.depth = int(row.get("depth", 0))
+        for name, v in (row.get("metrics") or {}).items():
+            mv = om.metrics.add()
+            mv.name = name
+            if name.startswith("elapsed_"):
+                mv.elapsed_secs = float(v)
+            elif isinstance(v, float):
+                # Python type IS the kind: MetricsSet stores gauges as
+                # float and counters as int, so an integral-valued gauge
+                # (e.g. selectivity=1.0) must stay a gauge on the wire —
+                # encoded as counter it would get SUMMED across tasks on
+                # stage aggregation instead of max-ed
+                mv.gauge = float(v)
+            else:
+                mv.counter = int(v)
+
+
+def task_metrics_from_proto(msg: "pb.TaskMetrics") -> Optional[dict]:
+    if not msg.operators and not msg.elapsed_total_secs:
+        return None
+    ops = []
+    for om in msg.operators:
+        metrics = {}
+        for mv in om.metrics:
+            which = mv.WhichOneof("value")
+            if which == "elapsed_secs":
+                metrics[mv.name] = mv.elapsed_secs
+            elif which == "gauge":
+                metrics[mv.name] = mv.gauge
+            else:
+                metrics[mv.name] = mv.counter
+        ops.append({"operator": om.operator, "depth": om.depth,
+                    "metrics": metrics})
+    return {"operators": ops, "elapsed_total": msg.elapsed_total_secs}
+
+
+def stage_metrics_to_proto(stages: Dict[int, dict], out) -> None:
+    """stages: stage_id -> {"num_tasks", "elapsed_total", "operators"};
+    ``out`` is a repeated StageMetrics field."""
+    for sid in sorted(stages):
+        st = stages[sid]
+        sm = out.add()
+        sm.stage_id = sid
+        sm.num_tasks = int(st.get("num_tasks", 1))
+        task_metrics_to_proto(st, sm.metrics)
+
+
+def stage_metrics_from_proto(msgs) -> Dict[int, dict]:
+    out: Dict[int, dict] = {}
+    for sm in msgs:
+        tm = task_metrics_from_proto(sm.metrics) or {
+            "operators": [], "elapsed_total": 0.0}
+        out[sm.stage_id] = {"num_tasks": sm.num_tasks or 1, **tm}
+    return out

@@ -20,7 +20,7 @@ KEYWORDS = {
 # Soft (contextual) keywords: only special at statement position, so
 # schemas with columns named e.g. ``verbose`` keep parsing (they lex as
 # plain identifiers; the parser matches them by value where relevant).
-SOFT_KEYWORDS = {"explain", "verbose"}
+SOFT_KEYWORDS = {"explain", "verbose", "analyze"}
 
 TWO_CHAR_OPS = ("<=", ">=", "<>", "!=", "||")
 ONE_CHAR_OPS = "+-*/%(),.;=<>"

@@ -62,10 +62,13 @@ class Query:
 
 @dataclass
 class ExplainStmt:
-    """EXPLAIN [VERBOSE] <select> (reference: rust/core/proto/
-    ballista.proto:232 ExplainNode; DataFusion's SQL EXPLAIN surface)."""
+    """EXPLAIN [ANALYZE] [VERBOSE] <select> (reference: rust/core/proto/
+    ballista.proto:232 ExplainNode; DataFusion's SQL EXPLAIN surface).
+    ``analyze`` executes the query and annotates the rendered plan with
+    live operator metrics."""
     query: "Query"
     verbose: bool = False
+    analyze: bool = False
 
 
 @dataclass
@@ -153,10 +156,17 @@ class Parser:
             return self.parse_create_external_table()
         if self._peek_soft("explain"):
             self.next()
-            verbose = False
-            if self._peek_soft("verbose"):
-                self.next()
-                verbose = True
+            verbose = analyze = False
+            # EXPLAIN [ANALYZE] [VERBOSE] — flags accepted in either order
+            while True:
+                if not verbose and self._peek_soft("verbose"):
+                    self.next()
+                    verbose = True
+                elif not analyze and self._peek_soft("analyze"):
+                    self.next()
+                    analyze = True
+                else:
+                    break
             if not self.peek().is_kw("select"):
                 raise SqlError(
                     f"EXPLAIN expects SELECT, got {self.peek().value!r}")
@@ -164,7 +174,7 @@ class Parser:
             self.accept_op(";")
             if self.peek().kind != "eof":
                 raise SqlError(f"trailing tokens at {self.peek().pos}")
-            return ExplainStmt(q, verbose)
+            return ExplainStmt(q, verbose, analyze)
         if self.peek().is_kw("select"):
             q = self.parse_query()
             self.accept_op(";")

@@ -77,9 +77,10 @@ class SqlPlanner:
 
     def plan(self, q) -> LogicalPlan:
         if isinstance(q, ExplainStmt):
-            # EXPLAIN [VERBOSE] <select>: wrap the planned query (reference
-            # surface: rust/core/proto/ballista.proto:232 ExplainNode)
-            return Explain(self.plan(q.query), q.verbose)
+            # EXPLAIN [ANALYZE] [VERBOSE] <select>: wrap the planned query
+            # (reference surface: rust/core/proto/ballista.proto:232
+            # ExplainNode)
+            return Explain(self.plan(q.query), q.verbose, q.analyze)
         if q.from_table is None:
             raise SqlError("SELECT without FROM not supported yet")
 

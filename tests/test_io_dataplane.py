@@ -149,3 +149,30 @@ def test_ipc_nullable_int64_exact_roundtrip(tmp_path):
     assert got.dtype == np.int64, f"int64 degraded to {got.dtype}"
     assert got[0] == big and got[2] == big + 2  # exact, no float rounding
     assert list(nulls["v"]) == [False, False, False, True]
+
+
+# ---------------------------------------------------------------------------
+# fixed-size-list decode honors a chunk slice offset
+# ---------------------------------------------------------------------------
+
+
+def test_fixed_size_list_decode_sliced_chunk():
+    # An Arrow slice adjusts offset/length only — the flat child stays
+    # whole. The decode must window the child by chunk.offset*width
+    # before reshaping, or a sliced producer silently reads the wrong
+    # rows (in-repo IPC files arrive unsliced; this protects direct
+    # zero-copy producers).
+    pa = pytest.importorskip("pyarrow")
+    flat = pa.array(np.arange(24, dtype=np.int64))
+    fsl = pa.FixedSizeListArray.from_arrays(flat, 4)  # 6 rows, width 4
+    sliced = fsl.slice(2, 3)
+    assert sliced.offset == 2  # precondition: a genuinely sliced chunk
+    got = ipc.decode_fixed_size_list(sliced)
+    np.testing.assert_array_equal(
+        got, np.arange(8, 20, dtype=np.int64).reshape(3, 4)
+    )
+    # unsliced stays the identity decode
+    np.testing.assert_array_equal(
+        ipc.decode_fixed_size_list(fsl),
+        np.arange(24, dtype=np.int64).reshape(6, 4),
+    )

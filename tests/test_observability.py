@@ -1,0 +1,409 @@
+"""Observability subsystem tests: operator metrics, EXPLAIN ANALYZE,
+cluster telemetry round-trip, tracing, and the metrics-overhead gate.
+
+The gate test (q1 SF0.01 overhead < 5%) is what keeps the "lock-cheap"
+claim honest: metrics default ON, so a regression in the instrument
+wrapper would silently tax every query.
+"""
+
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ballista_tpu.client import BallistaContext
+from ballista_tpu.datatypes import Float64, Int64, Utf8, schema
+from ballista_tpu.observability import metrics as obs_metrics
+from ballista_tpu.observability import tracing as obs_tracing
+from ballista_tpu.proto import ballista_pb2 as pb
+from ballista_tpu import serde
+
+
+@pytest.fixture
+def ctx():
+    c = BallistaContext.standalone()
+    c.register_memtable(
+        "t", schema(("k", Utf8), ("a", Int64), ("b", Float64)),
+        {"k": ["x", "y", "x", "y", "z"] * 8,
+         "a": list(range(40)),
+         "b": [float(i) / 2 for i in range(40)]},
+    )
+    c.register_memtable(
+        "u", schema(("k", Utf8), ("w", Int64)),
+        {"k": ["x", "y", "z"], "w": [10, 20, 30]},
+    )
+    return c
+
+
+@pytest.fixture
+def metrics_env():
+    """Restore metric enablement however a test mangles it."""
+    saved = os.environ.get("BALLISTA_METRICS")
+    yield
+    if saved is None:
+        os.environ.pop("BALLISTA_METRICS", None)
+    else:
+        os.environ["BALLISTA_METRICS"] = saved
+    obs_metrics.reconfigure()
+
+
+@pytest.fixture
+def trace_env(tmp_path):
+    """Enable tracing into a tmp file; restore + re-disable afterwards."""
+    saved = {k: os.environ.get(k)
+             for k in ("BALLISTA_TRACE", "BALLISTA_TRACE_FILE",
+                       "BALLISTA_TRACE_DIR")}
+    path = str(tmp_path / "trace.jsonl")
+    os.environ["BALLISTA_TRACE"] = "1"
+    os.environ["BALLISTA_TRACE_FILE"] = path
+    obs_tracing.reconfigure()
+    yield path
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    obs_tracing.reconfigure()
+
+
+def _op_rows(qm, prefix):
+    return [r for r in qm.operators() if r["operator"].startswith(prefix)]
+
+
+# ---------------------------------------------------------------------------
+# (a) operator metrics populate on a local query
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_populate_scan_filter_agg_join(ctx):
+    out = ctx.sql(
+        "SELECT t.k, sum(t.a) AS s, sum(u.w) AS ws FROM t "
+        "JOIN u ON t.k = u.k WHERE t.a > 0 GROUP BY t.k"
+    ).collect()
+    assert len(out) == 3
+    qm = ctx.last_query_metrics()
+    assert qm is not None and qm.stage_ids() == [0]
+    for prefix in ("ScanExec", "FilterExec", "HashAggregateExec",
+                   "JoinExec"):
+        rows = _op_rows(qm, prefix)
+        assert rows, f"no {prefix} row in {qm.pretty()}"
+        m = rows[0]["metrics"]
+        assert m.get("output_rows", 0) > 0, (prefix, m)
+        assert m.get("elapsed_compute", 0.0) > 0.0, (prefix, m)
+    # scans saw every row of their table
+    scans = _op_rows(qm, "ScanExec")
+    assert sorted(r["metrics"]["output_rows"] for r in scans) == [3, 40]
+    # derived self-time never exceeds cumulative time
+    for r in qm.operators():
+        m = r["metrics"]
+        if "elapsed_self" in m:
+            assert m["elapsed_self"] <= m["elapsed_compute"] + 1e-9
+    assert qm.total_output_rows() == 3
+
+
+def test_repeat_collect_reports_single_run(ctx):
+    # the plan cache reuses the physical plan across collects; metrics
+    # must reset per run, not accumulate (and pending device row-count
+    # scalars must drain rather than grow with every batch)
+    sql = "SELECT k, sum(a) AS s FROM t GROUP BY k"
+    for _ in range(3):
+        ctx.sql(sql).collect()
+        qm = ctx.last_query_metrics()
+        scans = _op_rows(qm, "ScanExec")
+        assert [r["metrics"]["output_rows"] for r in scans] == [40]
+
+
+def test_metrics_disabled_yields_none(ctx, metrics_env):
+    os.environ["BALLISTA_METRICS"] = "0"
+    obs_metrics.reconfigure()
+    ctx.sql("SELECT sum(a) AS s FROM t").collect()
+    assert ctx.last_query_metrics() is None
+
+
+# ---------------------------------------------------------------------------
+# (b) EXPLAIN ANALYZE carries row counts and timings
+# ---------------------------------------------------------------------------
+
+
+def test_explain_analyze_rows_annotated(ctx):
+    out = ctx.sql(
+        "EXPLAIN ANALYZE SELECT k, sum(a) AS s FROM t GROUP BY k"
+    ).collect()
+    rows = dict(zip(out["plan_type"], out["plan"]))
+    plan = rows["plan_with_metrics"]
+    assert "output_rows=" in plan and "elapsed_compute=" in plan
+    assert "HashAggregateExec" in plan and "ScanExec" in plan
+    assert float(rows["total_elapsed"].rstrip("s")) > 0.0
+
+
+def test_explain_analyze_repeat_does_not_accumulate(ctx):
+    # the standalone plan cache reuses the physical plan; ANALYZE must
+    # reset its MetricsSets or the second run reports doubled numbers
+    sql = "EXPLAIN ANALYZE SELECT k, sum(a) AS s FROM t GROUP BY k"
+    import re
+
+    def scan_rows(plan):
+        m = re.search(r"ScanExec.*?output_rows=(\d+)", plan)
+        return int(m.group(1))
+
+    for _ in range(2):
+        out = ctx.sql(sql).collect()
+        plan = dict(zip(out["plan_type"], out["plan"]))["plan_with_metrics"]
+        assert scan_rows(plan) == 40
+
+
+def test_explain_analyze_verbose_and_flag_order(ctx):
+    for sql in ("EXPLAIN ANALYZE VERBOSE SELECT k FROM t",
+                "EXPLAIN VERBOSE ANALYZE SELECT k FROM t"):
+        out = ctx.sql(sql).collect()
+        types = list(out["plan_type"])
+        assert "logical_plan" in types and "plan_with_metrics" in types
+
+
+def test_dataframe_explain_analyze_verb(ctx):
+    from ballista_tpu import expr as ex
+
+    df = (ctx.table("t").filter(ex.col("a") > ex.lit(2))
+          .aggregate([ex.col("k")], [ex.sum_(ex.col("a")).alias("s")]))
+    txt = df.explain_analyze()
+    assert "output_rows=" in txt and "elapsed_compute=" in txt
+    assert "FilterExec" in txt and "HashAggregateExec" in txt
+
+
+def test_explain_analyze_measures_even_when_disabled(ctx, metrics_env):
+    # force_metrics: ANALYZE must measure under BALLISTA_METRICS=0
+    os.environ["BALLISTA_METRICS"] = "0"
+    obs_metrics.reconfigure()
+    out = ctx.sql("EXPLAIN ANALYZE SELECT sum(a) AS s FROM t").collect()
+    plan = dict(zip(out["plan_type"], out["plan"]))["plan_with_metrics"]
+    assert "output_rows=" in plan
+
+
+# ---------------------------------------------------------------------------
+# (c) cluster path round-trips TaskMetrics through the scheduler
+# ---------------------------------------------------------------------------
+
+
+def test_task_metrics_proto_roundtrip():
+    tm = {
+        "operators": [
+            {"operator": "ScanExec: t", "depth": 1,
+             "metrics": {"output_rows": 40, "elapsed_compute": 0.5,
+                         "selectivity": 0.25}},
+            {"operator": "ShuffleWrite", "depth": 0,
+             "metrics": {"bytes_written": 1394}},
+        ],
+        "elapsed_total": 1.25,
+    }
+    msg = pb.TaskMetrics()
+    serde.task_metrics_to_proto(tm, msg)
+    back = serde.task_metrics_from_proto(msg)
+    assert back == tm  # counters int, timers/gauges float — kinds survive
+
+
+def test_total_output_rows_uses_final_stage():
+    # multi-stage: earlier stages feed shuffles; only the last stage's
+    # root is the query output
+    qm = obs_metrics.QueryMetrics({
+        1: {"num_tasks": 4, "elapsed_total": 0.4,
+            "operators": [{"operator": "ShuffleWrite", "depth": 0,
+                           "metrics": {"output_rows": 16}}]},
+        2: {"num_tasks": 1, "elapsed_total": 0.1,
+            "operators": [{"operator": "HashAggregateExec", "depth": 0,
+                           "metrics": {"output_rows": 2}}]},
+    })
+    assert qm.total_output_rows() == 2
+
+
+def test_integral_gauge_keeps_kind_and_maxes_on_merge():
+    # set_gauge(x, 1.0) must stay a gauge through the wire and be
+    # max-ed (not summed) when tasks of a stage merge
+    ms = obs_metrics.MetricsSet()
+    ms.set_gauge("selectivity", 1)  # int input coerced to float
+    row = {"operator": "FilterExec", "depth": 0, "metrics": ms.values()}
+    msg = pb.TaskMetrics()
+    serde.task_metrics_to_proto({"operators": [row]}, msg)
+    back = serde.task_metrics_from_proto(msg)
+    v = back["operators"][0]["metrics"]["selectivity"]
+    assert isinstance(v, float) and v == 1.0
+    merged = obs_metrics.merge_operator_metrics(
+        [back["operators"], back["operators"], back["operators"]])
+    assert merged[0]["metrics"]["selectivity"] == 1.0  # max, not 3
+
+
+def test_elapsed_self_sums_within_total(ctx):
+    # fused pipeline intermediates record no own time; self-time
+    # attribution must not double count their subtree (sum of
+    # elapsed_self across the plan stays within the root's cumulative)
+    ctx.sql("SELECT k, sum(a) AS s FROM t WHERE a > 1 GROUP BY k").collect()
+    qm = ctx.last_query_metrics()
+    ops = qm.operators()
+    root_total = ops[0]["metrics"]["elapsed_compute"]
+    self_sum = sum(r["metrics"].get("elapsed_self", 0.0) for r in ops)
+    assert self_sum <= root_total * 1.001 + 1e-9, qm.pretty()
+
+
+def test_stage_metrics_proto_roundtrip():
+    stages = {
+        1: {"num_tasks": 2, "elapsed_total": 0.75,
+            "operators": [{"operator": "ScanExec", "depth": 0,
+                           "metrics": {"output_rows": 80,
+                                       "elapsed_compute": 0.25}}]},
+    }
+    job = pb.CompletedJob()
+    serde.stage_metrics_to_proto(stages, job.stage_metrics)
+    assert serde.stage_metrics_from_proto(job.stage_metrics) == stages
+
+
+def test_cluster_metrics_and_trace(tmp_path, trace_env):
+    from ballista_tpu.distributed.executor import LocalCluster
+
+    csv = tmp_path / "t.csv"
+    with open(csv, "w") as f:
+        f.write("k,a\n")
+        for i in range(40):
+            f.write(f"{'xy'[i % 2]},{i}\n")
+
+    cluster = LocalCluster(num_executors=2)
+    try:
+        ctx = BallistaContext.remote("localhost", cluster.port)
+        ctx.register_csv("t", str(csv), schema(("k", Utf8), ("a", Int64)))
+        out = ctx.sql(
+            "SELECT k, sum(a) AS s FROM t GROUP BY k ORDER BY k"
+        ).collect()
+        assert list(out["s"]) == [380, 400]
+
+        qm = ctx.last_query_metrics()
+        assert qm is not None and len(qm.stage_ids()) >= 2, repr(qm)
+        # per-stage aggregation reached the client: operator rows carry
+        # rows + timings, the shuffle reader read bytes, writers wrote
+        assert _op_rows(qm, "ScanExec")[0]["metrics"]["output_rows"] == 40
+        reader = _op_rows(qm, "ShuffleReaderExec")
+        assert reader and reader[0]["metrics"].get("bytes_read", 0) > 0
+        writes = [r for r in qm.operators()
+                  if r["operator"] in ("PartitionWrite", "ShuffleWrite")]
+        assert writes and all(
+            r["metrics"].get("bytes_written", 0) > 0 for r in writes)
+        for st in qm.stages.values():
+            assert st["num_tasks"] >= 1 and st["elapsed_total"] > 0.0
+
+        # EXPLAIN ANALYZE rides the cluster result channel annotated
+        out = ctx.sql(
+            "EXPLAIN ANALYZE SELECT k, sum(a) AS s FROM t GROUP BY k"
+        ).collect()
+        plan = dict(zip(out["plan_type"], out["plan"]))["plan_with_metrics"]
+        assert "output_rows=" in plan and "elapsed_compute=" in plan
+    finally:
+        cluster.shutdown()
+
+    # (d, cluster half) the run above emitted spans for every subsystem
+    spans = [json.loads(line) for line in open(trace_env)]
+    names = {s["name"] for s in spans}
+    assert {"scheduler.plan_job", "scheduler.task_dispatch",
+            "executor.task", "dataplane.write"} <= names, names
+
+
+# ---------------------------------------------------------------------------
+# (d) BALLISTA_TRACE=1 emits parseable span JSON
+# ---------------------------------------------------------------------------
+
+
+def test_trace_span_and_event_schema(trace_env):
+    from ballista_tpu.observability import trace_event, trace_span
+
+    assert obs_tracing.trace_enabled()
+    trace_event("test.instant", detail="x")
+    with trace_span("test.span", task="t1"):
+        time.sleep(0.01)
+    with pytest.raises(ValueError):
+        with trace_span("test.error"):
+            raise ValueError("boom")
+
+    recs = [json.loads(line) for line in open(trace_env)]
+    by_name = {r["name"]: r for r in recs}
+    inst = by_name["test.instant"]
+    assert inst["detail"] == "x" and "dur" not in inst
+    span = by_name["test.span"]
+    assert span["dur"] >= 0.01 and span["task"] == "t1"
+    assert by_name["test.error"]["error"] == "ValueError"
+    for r in recs:  # common schema
+        assert isinstance(r["ts"], float)
+        assert isinstance(r["pid"], int) and isinstance(r["tid"], int)
+
+
+def test_trace_disabled_by_default(tmp_path):
+    saved = os.environ.pop("BALLISTA_TRACE", None)
+    obs_tracing.reconfigure()
+    try:
+        assert not obs_tracing.trace_enabled()
+        from ballista_tpu.observability import trace_event
+
+        trace_event("test.noop")  # must not raise, must not write
+        assert not glob.glob(str(tmp_path / "*.jsonl"))
+    finally:
+        if saved is not None:
+            os.environ["BALLISTA_TRACE"] = saved
+        obs_tracing.reconfigure()
+
+
+# ---------------------------------------------------------------------------
+# (e) metrics overhead gate: q1 @ SF0.01 < 5%
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_overhead_q1_under_5pct(tmp_path_factory, metrics_env):
+    from benchmarks.tpch import datagen
+    from benchmarks.tpch.schema_def import register_tpch
+
+    data_dir = str(tmp_path_factory.mktemp("tpch_obs"))
+    datagen.generate(data_dir, scale=0.01, num_parts=1)
+    ctx = BallistaContext.standalone()
+    register_tpch(ctx, data_dir, "tbl")
+    qdir = os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                        "tpch", "queries")
+    df = ctx.sql(open(os.path.join(qdir, "q1.sql")).read())
+    df.collect()  # warm: jit compile + table caches
+
+    def sample(flag):
+        os.environ["BALLISTA_METRICS"] = flag
+        obs_metrics.reconfigure()
+        t0 = time.perf_counter()
+        for _ in range(3):  # longer samples so jitter shrinks vs budget
+            df.collect()
+        return time.perf_counter() - t0
+
+    # settle adaptive/jit state on both paths before measuring
+    sample("1")
+    sample("0")
+
+    def measure():
+        # interleaved pairs with ALTERNATING order (off/on, on/off, ...)
+        # so both a load spike and a monotonic load ramp hit the two
+        # sides equally; medians absorb what alternation doesn't cancel
+        # (profiling puts the true wrapper cost at ~0.1ms/collect —
+        # everything else here is machine noise)
+        offs, ons = [], []
+        for i in range(9):
+            if i % 2 == 0:
+                offs.append(sample("0"))
+                ons.append(sample("1"))
+            else:
+                ons.append(sample("1"))
+                offs.append(sample("0"))
+        return sorted(offs)[4], sorted(ons)[4]
+
+    # up to 3 attempts: a co-tenant CPU burst can still push one
+    # measurement over the line, but a REAL >5% regression fails all
+    # three; the 2ms absolute floor covers runs whose whole 5% budget
+    # is itself only a few milliseconds
+    for attempt in range(3):
+        t_off, t_on = measure()
+        if t_on <= t_off * 1.05 + 2e-3:
+            return
+    overhead = (t_on - t_off) / t_off
+    raise AssertionError(
+        f"metrics overhead {overhead:.1%} (on={t_on:.4f}s off={t_off:.4f}s)"
+    )

@@ -56,6 +56,12 @@ def _submit_groupby(svc, src):
     while not svc.state.stage_ids(job_id):
         assert time.time() < deadline, "planning never finished"
         time.sleep(0.05)
+    # stage plans persist BEFORE the ready queue is seeded (enqueue_job
+    # runs last in the planning thread); wait until tasks are actually
+    # dispatchable or the first manual _pump races planning under load
+    while not svc.state._ready:
+        assert time.time() < deadline, "job never enqueued"
+        time.sleep(0.05)
     return job_id
 
 
