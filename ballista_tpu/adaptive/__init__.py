@@ -1,0 +1,31 @@
+"""Adaptive query execution (AQE): re-plan not-yet-scheduled stages from
+observed runtime metrics instead of static estimates.
+
+The reference engine (like pre-3.0 Spark) fixes partition counts and join
+strategies at plan time (reference: docs/architecture.md:9-18 — stages are
+carved out of a static physical plan before a single row is read). This
+subsystem closes the loop the observability layer opened: each completed
+stage reports real per-partition shuffle byte histograms, and the
+scheduler rewrites the stages that have not started yet. Three rules, each
+independently gateable (see :class:`AdaptiveConfig`):
+
+- **shuffle partition coalescing** — merge adjacent small hash-shuffle
+  partitions so each reader task sees ~``target_partition_bytes``;
+- **join strategy demotion** — when the build side of a planned
+  shuffle-hash join lands under ``broadcast_threshold_bytes``, broadcast
+  it and drop the probe side's shuffle repartition;
+- **skew splitting** — split a shuffle partition whose bytes exceed
+  ``skew_factor`` x the median into producer-subrange sub-tasks.
+
+Cluster path: ``replanner`` hooks stage completion in the scheduler
+state machine. Standalone path: ``standalone`` applies the same rules
+between pipeline breakers inside one process.
+"""
+
+from .config import AdaptiveConfig  # noqa: F401
+from .rules import (  # noqa: F401
+    describe_layout,
+    layout_is_identity,
+    plan_shuffle_reads,
+    should_broadcast,
+)

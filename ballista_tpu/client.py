@@ -242,9 +242,29 @@ class BallistaContext:
             # drains pending device row-count scalars, which would
             # otherwise grow unboundedly when metrics are never read
             reset_plan_metrics(phys)
+        phys = self._apply_adaptive(phys)
         out = pd.DataFrame(collect_physical(phys))
         self._record_plan_metrics(phys)
         return out, phys
+
+    def _apply_adaptive(self, phys):
+        """Standalone adaptive execution: rewrite the planned tree from
+        observed pipeline-breaker histograms (adaptive/standalone.py).
+        Runs once per plan — cached DataFrames keep the adapted tree —
+        and leaves EXPLAIN [ANALYZE] leaves alone (ANALYZE applies the
+        rules itself, inside its measured window)."""
+        if getattr(phys, "_adaptive_applied", False):
+            return phys
+        from .adaptive import AdaptiveConfig
+        from .adaptive.standalone import apply_adaptive_rules
+        from .physical.explain import ExplainAnalyzeExec, ExplainExec
+
+        if not isinstance(phys, (ExplainAnalyzeExec, ExplainExec)):
+            conf = AdaptiveConfig.from_settings(self.settings)
+            if conf.enabled:
+                phys = apply_adaptive_rules(phys, conf)
+        phys._adaptive_applied = True
+        return phys
 
     def _record_plan_metrics(self, phys) -> None:
         from .observability.metrics import metrics_enabled

@@ -169,13 +169,14 @@ class Executor:
                             self.mesh_group.wait_acks(seq)
                     else:
                         stats = self.execute_partition(pid, plan, shuffle)
-                self._report_completed(pid, stats)
+                self._report_completed(pid, stats, td.stage_version)
             except Exception as e:  # noqa: BLE001 - task failure
                 log.exception("task %s failed", pid)
                 # prefix the exception class: the scheduler retries
                 # transient (IO-shaped) failures but fails fast on
                 # deterministic ones (bad plans, overflow limits)
-                self._report_failed(pid, f"{type(e).__name__}: {e}")
+                self._report_failed(pid, f"{type(e).__name__}: {e}",
+                                    td.stage_version)
             finally:
                 self._slots.release()
 
@@ -263,6 +264,9 @@ class Executor:
                 )
             offset += b.num_rows_host()
         base = None
+        # per-output-partition byte histogram: the signal adaptive
+        # re-planning coalesces/splits the consuming stage on
+        qbytes = []
         with trace_span("dataplane.write", task=pid.key(), fan_out=n_out):
             for q in range(n_out):
                 path = shuffle_path(self.config.work_dir, pid.job_id,
@@ -270,17 +274,21 @@ class Executor:
                 base = path
                 st = ipc.write_partition(path, masked[q],
                                          compute_column_stats=False)
+                qbytes.append(int(st["num_bytes"]))
                 for k in totals:
                     totals[k] += st[k]
+        totals["shuffle_partition_bytes"] = qbytes
         log.info("executed %s (shuffle x%d) in %.1fs (%d rows)", pid.key(),
                  n_out, time.time() - t0, totals["num_rows"])
         return {**totals, "path": base}
 
-    def _report_completed(self, pid: PartitionId, stats: dict):
+    def _report_completed(self, pid: PartitionId, stats: dict,
+                          stage_version: int = 0):
         ts = pb.TaskStatus()
         ts.partition_id.job_id = pid.job_id
         ts.partition_id.stage_id = pid.stage_id
         ts.partition_id.partition_id = pid.partition_id
+        ts.stage_version = stage_version
         ts.completed.executor_id = self.id
         ts.completed.path = stats["path"]
         tm = stats.get("task_metrics")
@@ -290,11 +298,13 @@ class Executor:
         with self._status_lock:
             self._pending_status.append(ts)
 
-    def _report_failed(self, pid: PartitionId, error: str):
+    def _report_failed(self, pid: PartitionId, error: str,
+                       stage_version: int = 0):
         ts = pb.TaskStatus()
         ts.partition_id.job_id = pid.job_id
         ts.partition_id.stage_id = pid.stage_id
         ts.partition_id.partition_id = pid.partition_id
+        ts.stage_version = stage_version
         ts.failed.error = error
         with self._status_lock:
             self._pending_status.append(ts)

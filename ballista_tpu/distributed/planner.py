@@ -122,9 +122,16 @@ def find_unresolved_shuffles(plan: PhysicalPlan) -> List[UnresolvedShuffleExec]:
 def remove_unresolved_shuffles(
     plan: PhysicalPlan,
     locations: Dict[int, List[PartitionLocation]],  # stage_id -> locations
+    reader_info: "Dict[int, dict] | None" = None,
 ) -> PhysicalPlan:
     """Substitute resolved ShuffleReaderExecs (reference:
-    planner.rs:236-269)."""
+    planner.rs:236-269).
+
+    ``reader_info`` (stage_id -> {"read_partitions", "hash_columns",
+    "original_partitions"}) carries the adaptive reader layout and the
+    producing stage's hash-partitioning columns into the reader, so the
+    in-task plan both respects AQE decisions and reports trustworthy
+    co-partitioning (the ``Partitioning("unknown", n)`` fix)."""
     if isinstance(plan, UnresolvedShuffleExec):
         locs: List[PartitionLocation] = []
         for sid in plan.query_stage_ids:
@@ -133,10 +140,19 @@ def remove_unresolved_shuffles(
             locs.extend(
                 sorted(locations[sid], key=lambda l: l.partition_id)
             )
-        return ShuffleReaderExec(locs, plan.output_schema())
+        info = {}
+        if reader_info and len(plan.query_stage_ids) == 1:
+            info = reader_info.get(plan.query_stage_ids[0]) or {}
+        return ShuffleReaderExec(
+            locs, plan.output_schema(),
+            read_partitions=info.get("read_partitions"),
+            hash_columns=tuple(info.get("hash_columns") or ()),
+            original_partitions=info.get("original_partitions", 0),
+        )
     children = plan.children()
     if not children:
         return plan
     return plan.with_new_children(
-        [remove_unresolved_shuffles(c, locations) for c in children]
+        [remove_unresolved_shuffles(c, locations, reader_info)
+         for c in children]
     )

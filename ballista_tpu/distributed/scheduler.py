@@ -12,6 +12,7 @@ lib.rs — the 5 SchedulerGrpc RPCs; execute_query background planning at
 
 from __future__ import annotations
 
+import functools
 import logging
 import random
 import string
@@ -232,6 +233,12 @@ class SchedulerService:
         # duplicate straggler tasks older than this when executors idle;
         # 0 disables
         self.speculation_age_secs = speculation_age_secs
+        # adaptive query execution: re-plan not-yet-started stages from
+        # observed stage metrics on every stage completion (per-job
+        # knobs ride the query settings; see adaptive/replanner.py)
+        from ..adaptive.replanner import replan_on_stage_complete
+
+        state.replan_hook = replan_on_stage_complete
 
     # -- RPC: ExecuteQuery --------------------------------------------------
 
@@ -289,6 +296,9 @@ class SchedulerService:
         from ..physical.planner import PlannerOptions
 
         t0 = time.time()
+        # persist the query settings: stage-completion re-planning reads
+        # its adaptive.* knobs from here for the job's whole lifetime
+        self.state.save_job_settings(job_id, settings or {})
         if logical_plan is None:
             logical_plan = self._plan_sql(sql, catalog_entries or [])
         phys = plan_logical(logical_plan,
@@ -341,6 +351,12 @@ class SchedulerService:
         for ts in request.task_status:
             st = _task_status_from_proto(ts)
             jobs_touched.add(st.partition.job_id)
+            if not self.state.accept_report_version(st):
+                # the task was cut from a stage version an adaptive
+                # re-plan superseded: its output layout no longer
+                # matches the plan — drop the report (the state reset
+                # any stranded current-version twin)
+                continue
             if st.state == "completed":
                 self.state.task_completed(st)
             elif st.state == "failed" and self.state.is_completed(st.partition):
@@ -399,36 +415,51 @@ class SchedulerService:
 
     def _task_definition(self, task: PartitionId, meta: ExecutorMeta
                          ) -> pb.TaskDefinition:
-        plan_bytes, _, deps, shuffle_spec, _mesh = self.state.get_stage_plan(
-            task.job_id, task.stage_id
-        )
+        row = self.state.get_stage_plan(task.job_id, task.stage_id)
         node = pb.PhysicalPlanNode()
-        node.ParseFromString(plan_bytes)
+        node.ParseFromString(row.plan_bytes)
         plan = serde.physical_from_proto(node)
-        if deps:
+        if row.deps:
             locations = self.state.stage_locations(task.job_id,
-                                                   stages=set(deps))
-            # expand hash-shuffled producer locations into per-consumer files
-            for dep in deps:
-                _, _, _, dep_spec, _ = self.state.get_stage_plan(task.job_id, dep)
-                if dep_spec is not None and locations.get(dep):
-                    # (missing/empty deps stay absent so shuffle resolution
-                    # fails loudly with PlanError, not a zero-group reader)
-                    locations[dep] = _expand_shuffle_locations(
-                        locations[dep], dep_spec[1]
-                    )
-            plan = remove_unresolved_shuffles(plan, locations)
+                                                   stages=set(row.deps))
+            # expand hash-shuffled producer locations into per-consumer
+            # files, and collect per-dep reader info: adaptive read
+            # layouts plus the producer's hash columns (so the resolved
+            # reader reports trustworthy co-partitioning)
+            reader_info = {}
+            for dep in row.deps:
+                dep_row = self.state.get_stage_plan(task.job_id, dep)
+                info = {}
+                if dep_row.shuffle_spec is not None:
+                    hx_bytes, n_out = dep_row.shuffle_spec
+                    info["hash_columns"] = _hash_column_names(hx_bytes)
+                    info["original_partitions"] = n_out
+                    if locations.get(dep):
+                        # (missing/empty deps stay absent so shuffle
+                        # resolution fails loudly with PlanError, not a
+                        # zero-group reader)
+                        locations[dep] = _expand_shuffle_locations(
+                            locations[dep], n_out
+                        )
+                    # adaptive layouts only apply to still-shuffled deps
+                    # (a demoted probe keeps a fallback layout that is
+                    # meaningless once its shuffle spec was stripped)
+                    if row.reader_layouts and dep in row.reader_layouts:
+                        info["read_partitions"] = row.reader_layouts[dep]
+                reader_info[dep] = info
+            plan = remove_unresolved_shuffles(plan, locations, reader_info)
         self.state.save_task_status(
             TaskStatus(task, "running", executor_id=meta.id,
-                       started_at=time.time())
+                       started_at=time.time(), stage_version=row.version)
         )
         td = pb.TaskDefinition()
         td.task_id.job_id = task.job_id
         td.task_id.stage_id = task.stage_id
         td.task_id.partition_id = task.partition_id
+        td.stage_version = row.version
         td.plan.CopyFrom(serde.physical_to_proto(plan))
-        if shuffle_spec is not None:
-            hx_bytes, n_out = shuffle_spec
+        if row.shuffle_spec is not None:
+            hx_bytes, n_out = row.shuffle_spec
             for hb in hx_bytes:
                 e = pb.LogicalExprNode()
                 e.ParseFromString(hb)
@@ -486,6 +517,30 @@ class SchedulerService:
         )
 
 
+def _hash_column_names(hx_bytes) -> list:
+    """Column names a shuffle stage hash-partitioned on, or [] when any
+    hash expr is not a plain column (then co-partitioning cannot be
+    keyed by name and the reader stays Partitioning("unknown")).
+    Memoized — the exprs are immutable per stage but this runs on every
+    task dispatch of every consumer."""
+    return list(_hash_column_names_cached(tuple(hx_bytes or ())))
+
+
+@functools.lru_cache(maxsize=512)
+def _hash_column_names_cached(hx_bytes: tuple) -> tuple:
+    from .. import expr as ex
+
+    names = []
+    for hb in hx_bytes:
+        e = pb.LogicalExprNode()
+        e.ParseFromString(hb)
+        parsed = serde.expr_from_proto(e)
+        if not isinstance(parsed, ex.ColumnRef):
+            return ()
+        names.append(parsed.column)
+    return tuple(names)
+
+
 def _expand_shuffle_locations(producer_locs, n_out: int):
     """Per-producer completed-task locations -> one location per
     (producer, consumer-partition) shuffle file."""
@@ -514,19 +569,23 @@ def _expand_shuffle_locations(producer_locs, n_out: int):
 def _task_status_from_proto(ts: pb.TaskStatus) -> TaskStatus:
     pid = PartitionId(ts.partition_id.job_id, ts.partition_id.stage_id,
                       ts.partition_id.partition_id)
+    ver = ts.stage_version
     which = ts.WhichOneof("status")
     if which == "running":
-        return TaskStatus(pid, "running", executor_id=ts.running.executor_id)
+        return TaskStatus(pid, "running", executor_id=ts.running.executor_id,
+                          stage_version=ver)
     if which == "failed":
-        return TaskStatus(pid, "failed", error=ts.failed.error)
+        return TaskStatus(pid, "failed", error=ts.failed.error,
+                          stage_version=ver)
     if which == "completed":
         return TaskStatus(
             pid, "completed", executor_id=ts.completed.executor_id,
             path=ts.completed.path,
             stats=serde.stats_from_proto(ts.completed.stats),
             metrics=serde.task_metrics_from_proto(ts.completed.metrics),
+            stage_version=ver,
         )
-    return TaskStatus(pid)
+    return TaskStatus(pid, stage_version=ver)
 
 
 # ---------------------------------------------------------------------------

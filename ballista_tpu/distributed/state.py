@@ -17,6 +17,7 @@ a global lock — stage-dependency checks run only when a stage completes.
 
 from __future__ import annotations
 
+import logging
 import pickle
 import sqlite3
 import threading
@@ -24,7 +25,16 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..errors import ClusterError
-from .types import ExecutorMeta, JobStatus, PartitionId, PartitionLocation, TaskStatus
+from .types import (
+    ExecutorMeta,
+    JobStatus,
+    PartitionId,
+    PartitionLocation,
+    StagePlan,
+    TaskStatus,
+)
+
+log = logging.getLogger("ballista.state")
 
 EXECUTOR_LEASE_SECS = 60  # reference: LEASE_TIME, state/mod.rs:42
 
@@ -148,6 +158,15 @@ class SqliteBackend(KvBackend):
 # ---------------------------------------------------------------------------
 
 
+def _pad_stage_row(row: tuple) -> tuple:
+    """Pad stage rows persisted by older schedulers to the current
+    7-field shape (plan_bytes, nparts, deps, shuffle_spec, mesh,
+    version, reader_layouts) — positional defaults, so a 5-field row
+    gets version 0 (not a mis-slotted mesh count)."""
+    defaults = (None, 0, 0, None)  # spec, mesh, version, layouts
+    return tuple(row) + defaults[len(row) - 3:]
+
+
 class SchedulerState:
     """Namespaced cluster state + scheduling queues.
 
@@ -169,6 +188,14 @@ class SchedulerState:
         self._stage_parts: Dict[Tuple[str, int], int] = {}
         # (job, stage) -> devices a task needs (0 = any)
         self._stage_mesh: Dict[Tuple[str, int], int] = {}
+        # (job, stage) -> current stage-plan version (adaptive re-plans
+        # bump it; reports from older versions are dropped)
+        self._stage_versions: Dict[Tuple[str, int], int] = {}
+        # adaptive re-plan hook, installed by the scheduler service:
+        # callable(state, job_id, completed_stage_id, ready_sids,
+        # blocked_sids) invoked (under the state lock) when a stage
+        # completes, BEFORE its newly-unblocked dependents are enqueued
+        self.replan_hook = None
         # tasks already handed out as speculative duplicates (at most one
         # duplicate per task), tasks with one absorbed failure while a
         # twin copy was still in flight, and the last speculation scan
@@ -193,11 +220,12 @@ class SchedulerState:
             for k, v in stage_rows:
                 job_id, sid = k[len(prefix):].split("/")
                 sid = int(sid)
-                row = (*pickle.loads(v), None, 0)[:5]
+                row = _pad_stage_row(pickle.loads(v))
                 _, nparts, deps = row[:3]
                 self._stage_deps[(job_id, sid)] = list(deps)
                 self._stage_parts[(job_id, sid)] = nparts
                 self._stage_mesh[(job_id, sid)] = row[4] or 0
+                self._stage_versions[(job_id, sid)] = row[5] or 0
                 jobs.add(job_id)
             for job_id in jobs:
                 js = self.get_job_status(job_id)
@@ -252,34 +280,180 @@ class SchedulerState:
         v = self.kv.get(self._k("jobs", job_id))
         return pickle.loads(v) if v is not None else None
 
+    def save_job_settings(self, job_id: str, settings: Dict[str, str]):
+        """Client ``settings`` of the submitted query, kept for the
+        lifetime of the job: adaptive re-planning reads its knobs from
+        here so the SUBMITTING client's configuration governs."""
+        self.kv.put(self._k("jobconf", job_id), pickle.dumps(dict(settings)))
+
+    def get_job_settings(self, job_id: str) -> Dict[str, str]:
+        v = self.kv.get(self._k("jobconf", job_id))
+        return pickle.loads(v) if v is not None else {}
+
     # -- stages -------------------------------------------------------------
 
     def save_stage_plan(self, job_id: str, stage_id: int, plan_bytes: bytes,
                         num_partitions: int, dep_stage_ids: List[int],
                         shuffle_spec: "tuple | None" = None,
-                        mesh_devices: int = 0):
+                        mesh_devices: int = 0, version: int = 0,
+                        reader_layouts: "dict | None" = None):
         # shuffle_spec: (serialized hash expr bytes list | None, n_outputs)
         # mesh_devices: devices a task of this stage needs (mesh-fused
         # stages only; 0 = any executor can run it)
+        # version / reader_layouts: adaptive re-planning state (StagePlan)
         self.kv.put(
             self._k("stages", job_id, stage_id),
             pickle.dumps(
                 (plan_bytes, num_partitions, dep_stage_ids, shuffle_spec,
-                 mesh_devices)
+                 mesh_devices, version, reader_layouts)
             ),
         )
         with self._lock:
             self._stage_deps[(job_id, stage_id)] = list(dep_stage_ids)
             self._stage_parts[(job_id, stage_id)] = num_partitions
             self._stage_mesh[(job_id, stage_id)] = mesh_devices
+            self._stage_versions[(job_id, stage_id)] = version
 
-    def get_stage_plan(self, job_id: str, stage_id: int):
+    def get_stage_plan(self, job_id: str, stage_id: int) -> StagePlan:
         v = self.kv.get(self._k("stages", job_id, stage_id))
         if v is None:
             raise ClusterError(f"no stage plan {job_id}/{stage_id}")
-        row = pickle.loads(v)
-        row = (*row, None, 0)[:5]  # pad older rows
-        return row  # (plan_bytes, num_partitions, deps, shuffle_spec, mesh)
+        return StagePlan(*_pad_stage_row(pickle.loads(v)))
+
+    def update_stage_plan(self, job_id: str, stage_id: int,
+                          plan_bytes: "bytes | None" = None,
+                          num_partitions: "int | None" = None,
+                          shuffle_spec: "tuple | None | str" = "keep",
+                          reader_layouts: "dict | None" = None) -> int:
+        """Adaptive re-plan of a NOT-YET-RUN stage: rewrite the stored
+        row, bump its version, and rebuild its (pending) task rows for
+        the new partition count. Returns the new version. Caller must
+        have verified no task of the stage has started; the version
+        bump protects against the narrow dispatch race that remains
+        (see accept_report_version)."""
+        with self._lock:
+            row = self.get_stage_plan(job_id, stage_id)
+            version = row.version + 1
+            new_spec = row.shuffle_spec if shuffle_spec == "keep" \
+                else shuffle_spec
+            self.save_stage_plan(
+                job_id, stage_id,
+                plan_bytes if plan_bytes is not None else row.plan_bytes,
+                num_partitions if num_partitions is not None
+                else row.num_partitions,
+                row.deps, new_spec, row.mesh_devices, version,
+                reader_layouts if reader_layouts is not None
+                else row.reader_layouts,
+            )
+            # task rows: drop every old row (the count may shrink) and
+            # recreate the new set pending
+            for t in self.get_task_statuses(job_id, stage_id):
+                self.kv.delete(
+                    self._k("tasks", job_id, stage_id,
+                            t.partition.partition_id)
+                )
+            n = num_partitions if num_partitions is not None \
+                else row.num_partitions
+            for p in range(n):
+                self.save_task_status(
+                    TaskStatus(PartitionId(job_id, stage_id, p))
+                )
+            # purge stale ready-queue entries (old partition ids), then
+            # re-seed if the stage is already unblocked
+            self._ready = [
+                p for p in self._ready
+                if not (p.job_id == job_id and p.stage_id == stage_id)
+            ]
+            deps = self._stage_deps.get((job_id, stage_id), [])
+            if all(self._stage_complete(job_id, d) for d in deps):
+                self._enqueue_stage(job_id, stage_id)
+            return version
+
+    def stage_version(self, job_id: str, stage_id: int) -> int:
+        with self._lock:
+            return self._stage_versions.get((job_id, stage_id), 0)
+
+    def accept_report_version(self, st: TaskStatus) -> bool:
+        """False when the report comes from a superseded stage version
+        (the executor ran a task cut before an adaptive re-plan): the
+        caller must drop it. A current-version twin may be stranded in
+        "running" by the dispatch race — reset + re-queue it so the
+        stage cannot hang."""
+        pid = st.partition
+        key = (pid.job_id, pid.stage_id)
+        with self._lock:
+            cur = self._stage_versions.get(key, 0)
+            if (st.stage_version or 0) == cur:
+                return True
+            n = self._stage_parts.get(key, 0)
+            if pid.partition_id < n and not self.is_completed(pid):
+                prior = next(
+                    (t for t in self.get_task_statuses(pid.job_id,
+                                                       pid.stage_id)
+                     if t.partition.partition_id == pid.partition_id),
+                    None,
+                )
+                # reset only a row STRANDED at a superseded version (the
+                # dispatch race); a running row already at the current
+                # version is a healthy re-dispatched copy — resetting it
+                # would spawn a redundant third execution
+                if prior is not None and prior.state == "running" and \
+                        (getattr(prior, "stage_version", 0) or 0) != cur:
+                    self._reset_task(pid)
+                    deps = self._stage_deps.get(key, [])
+                    if all(self._stage_complete(pid.job_id, d)
+                           for d in deps):
+                        self._enqueue_stage(pid.job_id, pid.stage_id)
+            log.info("dropping stale v%d report for %s (stage now v%d)",
+                     st.stage_version or 0, pid.key(), cur)
+            return False
+
+    def stage_started(self, job_id: str, stage_id: int) -> bool:
+        """True when any task of the stage has been dispatched (or
+        finished): adaptive re-planning must leave such stages alone."""
+        return any(t.state is not None
+                   for t in self.get_task_statuses(job_id, stage_id))
+
+    def shuffle_partition_histogram(self, job_id: str, stage_id: int):
+        """Observed shuffle output of a COMPLETED hash/round-robin
+        shuffle stage: ``(bytes_per_output, per_producer)`` where
+        ``per_producer[q][p]`` is the bytes producer task p wrote for
+        output partition q. None when the stage is not a shuffle, is
+        incomplete, or its tasks predate the histogram field."""
+        row = self.get_stage_plan(job_id, stage_id)
+        if row.shuffle_spec is None:
+            return None
+        n_out = row.shuffle_spec[1]
+        done = [t for t in self.get_task_statuses(job_id, stage_id)
+                if t.state == "completed"]
+        if len(done) < row.num_partitions:
+            return None
+        per = [[0] * row.num_partitions for _ in range(n_out)]
+        for t in done:
+            h = (t.stats or {}).get("shuffle_partition_bytes")
+            if not h or len(h) != n_out:
+                return None
+            p = t.partition.partition_id
+            for q in range(n_out):
+                per[q][p] = int(h[q])
+        return [sum(per[q]) for q in range(n_out)], per
+
+    def stage_output_bytes(self, job_id: str, stage_id: int
+                           ) -> Optional[int]:
+        """Total bytes a completed stage materialized (all tasks), or
+        None while incomplete — the join-demotion size signal."""
+        row = self.get_stage_plan(job_id, stage_id)
+        done = [t for t in self.get_task_statuses(job_id, stage_id)
+                if t.state == "completed"]
+        if len(done) < row.num_partitions:
+            return None
+        return sum(int((t.stats or {}).get("num_bytes", 0)) for t in done)
+
+    def stage_consumers(self, job_id: str, stage_id: int) -> List[int]:
+        """Stage ids that list ``stage_id`` as a dependency."""
+        with self._lock:
+            return [sid for (j, sid), deps in self._stage_deps.items()
+                    if j == job_id and stage_id in deps]
 
     def stage_ids(self, job_id: str) -> List[int]:
         prefix = self._k("stages", job_id) + "/"
@@ -377,11 +551,30 @@ class SchedulerState:
             # stage complete: enqueue dependents whose deps are all complete
             # (_enqueue_stage only picks up still-pending tasks, so this is
             # safe to re-trigger after recovery resets)
+            ready, blocked = [], []
             for (j, sid), deps in list(self._stage_deps.items()):
                 if j != job_id or stage_id not in deps:
                     continue
                 if all(self._stage_complete(j, d) for d in deps):
-                    self._enqueue_stage(j, sid)
+                    ready.append(sid)
+                else:
+                    blocked.append(sid)
+            if self.replan_hook is not None and (ready or blocked):
+                # adaptive re-planning window: dependents' plans may be
+                # rewritten from the completed stage's observed metrics
+                # BEFORE any of their tasks is enqueued. Best-effort: a
+                # re-plan failure must never take the job down with it —
+                # the static plan is always a correct fallback.
+                try:
+                    self.replan_hook(self, job_id, stage_id, ready, blocked)
+                except Exception:  # noqa: BLE001 - keep static plan
+                    log.exception(
+                        "adaptive re-plan failed for job %s after stage "
+                        "%d; continuing with the static plan",
+                        job_id, stage_id,
+                    )
+            for sid in ready:
+                self._enqueue_stage(job_id, sid)
 
     def _stage_complete(self, job_id: str, stage_id: int) -> bool:
         n = self._stage_parts.get((job_id, stage_id), 0)

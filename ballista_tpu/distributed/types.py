@@ -53,6 +53,27 @@ class TaskStatus:
     # per-operator execution metrics shipped with completion
     # ({"operators": [...], "elapsed_total": float}; see observability)
     metrics: Optional[dict] = None
+    # version of the stage plan the task ran against; reports from a
+    # superseded version (adaptive re-planning) are dropped
+    stage_version: int = 0
+
+
+@dataclass
+class StagePlan:
+    """One stage row as stored by the scheduler state (see
+    SchedulerState.save_stage_plan for field semantics)."""
+
+    plan_bytes: bytes
+    num_partitions: int
+    deps: list
+    shuffle_spec: Optional[tuple] = None
+    mesh_devices: int = 0
+    # bumped each time adaptive re-planning rewrites the stage; task
+    # definitions carry it and status reports echo it back
+    version: int = 0
+    # adaptive reader layouts: dep stage_id -> List[List[(out_lo,
+    # out_hi, prod_lo, prod_hi)]] (see adaptive/rules.py)
+    reader_layouts: Optional[dict] = None
 
 
 @dataclass

@@ -106,7 +106,7 @@ def plan_logical(plan: LogicalPlan, options=None) -> PhysicalPlan:
         # render before AND after optimization so EXPLAIN VERBOSE can show
         # what the optimizer did; the rows execute as a normal leaf node
         # (distributed: the text rides the standard shuffle/fetch path)
-        from .physical.explain import ExplainAnalyzeExec, render_explain
+        from .physical.explain import make_explain_analyze, render_explain
 
         inner = resolve_scalar_subqueries(plan.input, options)
         unopt = inner.pretty()
@@ -116,8 +116,9 @@ def plan_logical(plan: LogicalPlan, options=None) -> PhysicalPlan:
             # EXPLAIN ANALYZE: execute the plan and annotate it with live
             # metrics; the node is a leaf, so distributed runs ship the
             # whole analyzed plan as one task (observability docs)
-            return ExplainAnalyzeExec(phys, plan.verbose,
-                                      logical_text=opt.pretty())
+            return make_explain_analyze(
+                phys, plan.verbose, opt.pretty(),
+                getattr(options, "adaptive_settings", None))
         return render_explain(opt, phys, plan.verbose,
                               unoptimized_text=unopt)
     plan = resolve_scalar_subqueries(plan, options)

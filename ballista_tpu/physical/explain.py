@@ -66,10 +66,16 @@ class ExplainAnalyzeExec(PhysicalPlan):
     """
 
     def __init__(self, inner: PhysicalPlan, verbose: bool = False,
-                 logical_text: str | None = None):
+                 logical_text: str | None = None, adaptive_conf=None):
         self.inner = inner
         self.verbose = verbose
         self.logical_text = logical_text
+        # standalone adaptive execution config (AdaptiveConfig | None):
+        # ANALYZE applies the same rules a plain collect would, so the
+        # annotated plan shows the [adaptive: ...] decisions. None (the
+        # deserialized cluster-task case) analyzes the static plan.
+        self.adaptive_conf = adaptive_conf
+        self._adapted = False
 
     def output_schema(self) -> Schema:
         return EXPLAIN_SCHEMA
@@ -99,6 +105,16 @@ class ExplainAnalyzeExec(PhysicalPlan):
         reset_plan_metrics(self.inner)
         t0 = _time.perf_counter()
         with force_metrics():
+            if self.adaptive_conf is not None and \
+                    self.adaptive_conf.enabled and not self._adapted:
+                # inside force_metrics: the rewrite materializes
+                # pipeline-breaker inputs, and those executions must be
+                # measured like the rest of the run
+                from ..adaptive.standalone import apply_adaptive_rules
+
+                self.inner = apply_adaptive_rules(self.inner,
+                                                  self.adaptive_conf)
+                self._adapted = True
             for p in range(self.inner.output_partitioning().num_partitions):
                 for _ in self.inner.execute(p):
                     pass  # drain: ANALYZE reports metrics, not rows
@@ -120,6 +136,20 @@ class ExplainAnalyzeExec(PhysicalPlan):
 
     def display(self) -> str:
         return "ExplainAnalyzeExec"
+
+
+def make_explain_analyze(inner: PhysicalPlan, verbose: bool,
+                         logical_text: "str | None",
+                         settings: "dict | None") -> ExplainAnalyzeExec:
+    """The one place an analyzed plan resolves its AdaptiveConfig —
+    the SQL (execution.plan_logical) and direct (physical.planner)
+    EXPLAIN ANALYZE paths must not drift apart."""
+    from ..adaptive import AdaptiveConfig
+
+    return ExplainAnalyzeExec(
+        inner, verbose, logical_text=logical_text,
+        adaptive_conf=AdaptiveConfig.from_settings(settings),
+    )
 
 
 def render_explain(logical_input, physical_input: PhysicalPlan,

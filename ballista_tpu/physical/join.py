@@ -45,6 +45,7 @@ class JoinExec(PhysicalPlan):
         how: str = "inner",
         null_aware: bool = False,
         partitioned: bool = False,
+        adaptive_note: Optional[str] = None,
     ):
         if how not in JOIN_TYPES:
             raise NotImplementedError_(f"join type {how}")
@@ -62,6 +63,8 @@ class JoinExec(PhysicalPlan):
         # reference, which always passes join children through unsplit
         # (reference: rust/scheduler/src/planner.rs:172-173).
         self.partitioned = partitioned
+        # set when adaptive execution rewrote this join (EXPLAIN surface)
+        self.adaptive_note = adaptive_note
         # partition -> (table, batch, unique, has_null, key mode,
         #               codec tables, build keys, build live)
         self._build_data = {}
@@ -247,12 +250,15 @@ class JoinExec(PhysicalPlan):
 
     def with_new_children(self, children):
         return JoinExec(children[0], children[1], self.on, self.how,
-                        self.null_aware, self.partitioned)
+                        self.null_aware, self.partitioned,
+                        self.adaptive_note)
 
     def display(self) -> str:
         on = ", ".join(f"{l}={r}" for l, r in self.on)
         part = " partitioned" if self.partitioned else ""
-        return f"JoinExec: how={self.how} on=[{on}]{part}"
+        note = f" [adaptive: {self.adaptive_note}]" if self.adaptive_note \
+            else ""
+        return f"JoinExec: how={self.how} on=[{on}]{part}{note}"
 
     # -- execution ----------------------------------------------------------
 

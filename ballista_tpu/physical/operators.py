@@ -354,9 +354,35 @@ class RepartitionExec(PhysicalPlan):
         per-batch dispatch and assembly downstream. Mirrors the
         distributed path, where shuffle files are mask-compacted on IPC
         write."""
+        yield from self._execute_fragments(partition, 0, None)
+
+    def execute_fragments(self, partition: int, frag_lo: int,
+                          frag_hi: int) -> Iterator[ColumnBatch]:
+        """``execute(partition)`` restricted to source fragments
+        ``[frag_lo, frag_hi)`` — the read unit standalone adaptive skew
+        splitting carves a heavy partition by (fragments play the role
+        shuffle producers play in the cluster path)."""
+        yield from self._execute_fragments(partition, frag_lo, frag_hi)
+
+    def num_fragments(self) -> int:
+        return len(self._materialize_parts())
+
+    def observed_partition_rows(self):
+        """Post-materialization row histogram: ``(rows_per_partition,
+        rows[partition][fragment])`` — the standalone stand-in for the
+        cluster's shuffle byte histogram (bytes = rows x schema row
+        width, estimated by the caller)."""
+        parts = self._materialize_parts()
+        per = [[int(counts[q]) for _, _, counts in parts]
+               for q in range(self.num_partitions)]
+        return [sum(row) for row in per], per
+
+    def _execute_fragments(self, partition: int, frag_lo: int,
+                           frag_hi) -> Iterator[ColumnBatch]:
         self._jit_take = getattr(self, "_jit_take", {})
         pieces = []
-        for batch, perm, counts in self._materialize_parts():
+        for batch, perm, counts in self._materialize_parts()[
+                frag_lo:frag_hi]:
             n = int(counts[partition])
             start = int(counts[:partition].sum())
             # never exceed the source capacity: a longer slice would

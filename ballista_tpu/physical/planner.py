@@ -67,11 +67,16 @@ class PlannerOptions:
     # None keeps the merge plan; N produces an N-partition final stage
     # (the shape the mesh ICI fast path fuses — see distributed/scheduler)
     agg_partitions: Optional[int] = None
+    # raw settings snapshot: EXPLAIN ANALYZE resolves its AdaptiveConfig
+    # from here so analyzed plans run (and annotate) the same adaptive
+    # rules a plain collect would
+    adaptive_settings: Optional[Dict[str, str]] = None
 
     @staticmethod
     def from_settings(settings: Optional[Dict[str, str]]) -> "PlannerOptions":
         opts = PlannerOptions()
         s = settings or {}
+        opts.adaptive_settings = dict(s)
         if "join.partitioned.threshold" in s:
             v = s["join.partitioned.threshold"]
             opts.join_partition_threshold = (
@@ -233,12 +238,12 @@ def _create(plan: LogicalPlan, opts: PlannerOptions) -> PhysicalPlan:
     if isinstance(plan, Explain):
         # direct-call path (plan already optimized by the caller);
         # execution.plan_logical captures the pre-optimization text too
-        from .explain import ExplainAnalyzeExec, render_explain
+        from .explain import make_explain_analyze, render_explain
 
         if plan.analyze:
-            return ExplainAnalyzeExec(create_physical_plan(plan.input),
-                                      plan.verbose,
-                                      logical_text=plan.input.pretty())
+            return make_explain_analyze(
+                create_physical_plan(plan.input), plan.verbose,
+                plan.input.pretty(), opts.adaptive_settings)
         return render_explain(plan.input, create_physical_plan(plan.input),
                               plan.verbose)
 

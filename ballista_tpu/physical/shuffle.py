@@ -94,10 +94,13 @@ class ShuffleReaderExec(PhysicalPlan):
     """Reads completed shuffle partitions (reference:
     shuffle_reader.rs:33-100).
 
-    Two layouts:
+    Three layouts:
     - merge-style stages: output partition i maps 1:1 to location i;
     - hash-shuffled stages (locations carry ``shuffle_output``): output
-      partition q reads the shuffle-q file of EVERY producer partition.
+      partition q reads the shuffle-q file of EVERY producer partition;
+    - adaptive (``read_partitions``): output partition i reads the file
+      ranges the re-planner selected — coalesced spans of whole hash
+      buckets and/or producer subranges of a skew-split bucket.
     """
 
     # tests flip this to exercise the cross-host (socket) path even when
@@ -105,14 +108,38 @@ class ShuffleReaderExec(PhysicalPlan):
     FORCE_REMOTE = False
 
     def __init__(self, partition_locations: List[PartitionLocation],
-                 schema: Schema):
+                 schema: Schema, read_partitions=None,
+                 hash_columns=(), original_partitions: int = 0):
         self.partition_locations = list(partition_locations)
         self._schema = schema
         self._cache = {}
+        # read_partitions: List[List[(out_lo, out_hi, prod_lo, prod_hi)]],
+        # producer_hi == 0 selecting all producers (adaptive/rules.py)
+        self.read_partitions = (
+            [[tuple(r) for r in ranges] for ranges in read_partitions]
+            if read_partitions else None
+        )
+        # columns the producing stage hash-partitioned on: lets the
+        # in-task planner (and AQE join demotion) trust co-partitioning
+        # instead of seeing Partitioning("unknown", n)
+        self.hash_columns = tuple(hash_columns or ())
+        self.original_partitions = original_partitions
         shuffled = [
             l for l in self.partition_locations if l.shuffle_output is not None
         ]
-        if shuffled:
+        if shuffled and self.read_partitions:
+            self._groups = [
+                [
+                    l for l in shuffled
+                    if any(
+                        olo <= l.shuffle_output < ohi
+                        and (phi == 0 or plo <= l.partition_id < phi)
+                        for olo, ohi, plo, phi in ranges
+                    )
+                ]
+                for ranges in self.read_partitions
+            ]
+        elif shuffled:
             n_out = max(l.shuffle_output for l in shuffled) + 1
             self._groups: List[List[PartitionLocation]] = [
                 [l for l in shuffled if l.shuffle_output == q]
@@ -121,11 +148,22 @@ class ShuffleReaderExec(PhysicalPlan):
         else:
             self._groups = [[l] for l in self.partition_locations]
 
+    def _has_splits(self) -> bool:
+        from ..adaptive.rules import layout_has_splits
+
+        return bool(self.read_partitions) and \
+            layout_has_splits(self.read_partitions)
+
     def output_schema(self) -> Schema:
         return self._schema
 
     def output_partitioning(self) -> Partitioning:
-        return Partitioning("unknown", max(len(self._groups), 1))
+        n = max(len(self._groups), 1)
+        # coalesced groups are unions of whole hash buckets, so the hash
+        # property survives; producer-level skew splits break it
+        if self.hash_columns and not self._has_splits():
+            return Partitioning("hash", n, self.hash_columns)
+        return Partitioning("unknown", n)
 
     def estimated_rows(self) -> Optional[int]:
         """EXACT row count from the producers' write-time PartitionStats
@@ -215,4 +253,10 @@ class ShuffleReaderExec(PhysicalPlan):
         yield from self._load_group(partition)
 
     def display(self) -> str:
-        return f"ShuffleReaderExec: {len(self.partition_locations)} partitions"
+        out = f"ShuffleReaderExec: {len(self.partition_locations)} partitions"
+        if self.read_partitions:
+            from ..adaptive.rules import describe_layout
+
+            n_before = self.original_partitions or len(self.read_partitions)
+            out += f" [adaptive: {describe_layout(n_before, self.read_partitions)}]"
+        return out

@@ -406,6 +406,7 @@ def physical_to_proto(plan) -> pb.PhysicalPlanNode:
         n.join.how = plan.how
         n.join.null_aware = plan.null_aware
         n.join.partitioned = plan.partitioned
+        n.join.adaptive_note = plan.adaptive_note or ""
     elif isinstance(plan, MeshJoinExec):
         n.mesh_join.build_producer.CopyFrom(
             physical_to_proto(plan.build_producer))
@@ -448,6 +449,13 @@ def physical_to_proto(plan) -> pb.PhysicalPlanNode:
         for loc in plan.partition_locations:
             n.shuffle_reader.partition_location.append(location_to_proto(loc))
         n.shuffle_reader.schema.CopyFrom(schema_to_proto(plan.output_schema()))
+        for ranges in plan.read_partitions or []:
+            rp = n.shuffle_reader.read_partitions.add()
+            for olo, ohi, plo, phi in ranges:
+                rp.ranges.add(output_lo=olo, output_hi=ohi,
+                              producer_lo=plo, producer_hi=phi)
+        n.shuffle_reader.hash_columns.extend(plan.hash_columns)
+        n.shuffle_reader.original_partitions = plan.original_partitions
     elif isinstance(plan, UnresolvedShuffleExec):
         n.unresolved_shuffle.query_stage_ids.extend(plan.query_stage_ids)
         n.unresolved_shuffle.schema.CopyFrom(schema_to_proto(plan.output_schema()))
@@ -503,6 +511,7 @@ def physical_from_proto(n: pb.PhysicalPlanNode):
             n.join.how,
             null_aware=n.join.null_aware,
             partitioned=n.join.partitioned,
+            adaptive_note=n.join.adaptive_note or None,
         )
     if kind == "mesh_join":
         from .physical.mesh_agg import MeshJoinExec as _MeshJoinExec
@@ -551,6 +560,13 @@ def physical_from_proto(n: pb.PhysicalPlanNode):
         return ShuffleReaderExec(
             [location_from_proto(l) for l in n.shuffle_reader.partition_location],
             schema_from_proto(n.shuffle_reader.schema),
+            read_partitions=[
+                [(r.output_lo, r.output_hi, r.producer_lo, r.producer_hi)
+                 for r in rp.ranges]
+                for rp in n.shuffle_reader.read_partitions
+            ] or None,
+            hash_columns=tuple(n.shuffle_reader.hash_columns),
+            original_partitions=n.shuffle_reader.original_partitions,
         )
     if kind == "unresolved_shuffle":
         return UnresolvedShuffleExec(
@@ -604,6 +620,9 @@ def stats_to_proto(stats: dict, msg: "pb.PartitionStats") -> None:
     msg.num_rows = stats.get("num_rows", 0)
     msg.num_batches = stats.get("num_batches", 0)
     msg.num_bytes = stats.get("num_bytes", 0)
+    msg.shuffle_partition_bytes.extend(
+        int(b) for b in stats.get("shuffle_partition_bytes") or []
+    )
     for c in stats.get("columns") or []:
         cs = msg.column_stats.add()
         cs.name = c.get("name", "")
@@ -632,6 +651,8 @@ def stats_from_proto(msg: "pb.PartitionStats") -> dict:
         "num_batches": msg.num_batches,
         "num_bytes": msg.num_bytes,
     }
+    if msg.shuffle_partition_bytes:
+        out["shuffle_partition_bytes"] = list(msg.shuffle_partition_bytes)
     cols = []
     for cs in msg.column_stats:
         c = {"name": cs.name, "null_count": cs.null_count,

@@ -123,6 +123,42 @@ def apply_observability(fdp: dp.FileDescriptorProto) -> None:
               repeated=True)
 
 
+def apply_adaptive(fdp: dp.FileDescriptorProto) -> None:
+    """PR 2: adaptive query execution wire fields (mirrored by hand in
+    ballista.proto — keep the two in sync; dev/check_proto_sync.py
+    guards the drift)."""
+    # per-output-partition shuffle byte histogram on task stats
+    add_field(get_message(fdp, "PartitionStats"), "shuffle_partition_bytes",
+              5, F.TYPE_INT64, repeated=True)
+
+    # adaptive reader layout on ShuffleReaderNode
+    if not has_message(fdp, "ShuffleReadRange"):
+        m = fdp.message_type.add(name="ShuffleReadRange")
+        add_field(m, "output_lo", 1, F.TYPE_UINT32)
+        add_field(m, "output_hi", 2, F.TYPE_UINT32)
+        add_field(m, "producer_lo", 3, F.TYPE_UINT32)
+        add_field(m, "producer_hi", 4, F.TYPE_UINT32)
+    if not has_message(fdp, "ShuffleReadPartition"):
+        m = fdp.message_type.add(name="ShuffleReadPartition")
+        add_field(m, "ranges", 1, F.TYPE_MESSAGE,
+                  type_name=".ballista_tpu.ShuffleReadRange", repeated=True)
+    reader = get_message(fdp, "ShuffleReaderNode")
+    add_field(reader, "read_partitions", 3, F.TYPE_MESSAGE,
+              type_name=".ballista_tpu.ShuffleReadPartition", repeated=True)
+    add_field(reader, "hash_columns", 4, F.TYPE_STRING, repeated=True)
+    add_field(reader, "original_partitions", 5, F.TYPE_UINT32)
+
+    # join demotion annotation
+    add_field(get_message(fdp, "PhysicalJoinNode"), "adaptive_note", 7,
+              F.TYPE_STRING)
+
+    # stage versioning: definitions carry it, status reports echo it
+    add_field(get_message(fdp, "TaskDefinition"), "stage_version", 5,
+              F.TYPE_UINT32)
+    add_field(get_message(fdp, "TaskStatus"), "stage_version", 5,
+              F.TYPE_UINT32)
+
+
 TEMPLATE = '''# -*- coding: utf-8 -*-
 # Generated by dev/gen_proto_patch.py (no protoc in this image). DO NOT EDIT!
 # source: ballista.proto
@@ -150,6 +186,7 @@ def main() -> None:
     blob = load_serialized_blob(PB2)
     fdp = dp.FileDescriptorProto.FromString(blob)
     apply_observability(fdp)
+    apply_adaptive(fdp)
     out = TEMPLATE.format(blob=fdp.SerializeToString())
     with open(PB2, "w") as f:
         f.write(out)
