@@ -88,9 +88,21 @@ _cache_dir = _os.environ.get(
 )
 if _cache_dir:
     try:
+        _min_compile_secs = float(
+            _os.environ.get("BALLISTA_XLA_CACHE_MIN_COMPILE_SECS", "0"))
+    except ValueError:
+        _min_compile_secs = 0.0
+    try:
         _os.makedirs(_cache_dir, exist_ok=True)
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        # default 0: cache EVERY kernel. The old 0.1s floor silently
+        # excluded small kernels from the disk cache, so they recompiled
+        # in every fresh process — exactly the per-shape cold-path cost
+        # the shape-bucket ladder exists to amortize. Raise via
+        # BALLISTA_XLA_CACHE_MIN_COMPILE_SECS if cache-dir churn matters
+        # more than cold-start latency.
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           _min_compile_secs)
         _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except (OSError, AttributeError):  # unwritable dir / older jax
         pass

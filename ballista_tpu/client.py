@@ -242,6 +242,17 @@ class BallistaContext:
             # drains pending device row-count scalars, which would
             # otherwise grow unboundedly when metrics are never read
             reset_plan_metrics(phys)
+        # optional (BALLISTA_PREWARM=1): AOT-compile scan-side pipeline
+        # chains in the background, overlapping XLA compile with the
+        # scan's parse + host-to-device upload. Must start BEFORE the
+        # adaptive pass: standalone adaptive eagerly materializes
+        # repartition inputs (parse + upload + chain compiles) on this
+        # thread, which is exactly the work prewarm wants to overlap.
+        # The chains prewarm targets are scan-rooted and unchanged by
+        # the adaptive rewrites.
+        from .compile import maybe_prewarm
+
+        maybe_prewarm(phys)
         phys = self._apply_adaptive(phys)
         out = pd.DataFrame(collect_physical(phys))
         self._record_plan_metrics(phys)

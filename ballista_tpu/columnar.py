@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .compile import bucket_capacity, governed
 from .datatypes import DataType, Field, Schema, Utf8
 from .errors import ExecutionError, SchemaError
 
@@ -56,7 +57,6 @@ _NARROW_LADDER = {
     np.dtype(np.int32): (np.int8, np.int16),
 }
 
-_WIDEN_JITS: dict = {}
 _NARROW_WIRE: Optional[bool] = None
 
 
@@ -91,11 +91,10 @@ def _upload(arr: np.ndarray, want: np.dtype) -> jax.Array:
     for narrow in ladder:
         info = np.iinfo(narrow)
         if info.min <= lo and hi <= info.max:
-            key = (narrow, np.dtype(want).name)
-            fn = _WIDEN_JITS.get(key)
-            if fn is None:
-                fn = jax.jit(lambda a, _w=np.dtype(want): a.astype(_w))
-                _WIDEN_JITS[key] = fn
+            fn = governed(
+                ("wire.widen", np.dtype(narrow).name, np.dtype(want).name),
+                lambda _w=np.dtype(want): (lambda a: a.astype(_w)),
+            )
             return fn(jnp.asarray(arr.astype(narrow)))
     return jnp.asarray(arr)
 
@@ -273,7 +272,10 @@ class ColumnBatch:
             elif len(arr) != n:
                 raise SchemaError(f"column {name} length {len(arr)} != {n}")
         n = n or 0
-        cap = capacity or round_capacity(n)
+        # default capacities land on the canonical bucket ladder so
+        # every batch-entry boundary produces ladder shapes (explicit
+        # capacities — internal small result batches — stay exact)
+        cap = capacity or bucket_capacity(n)
         if cap < n:
             raise ExecutionError(f"capacity {cap} < rows {n}")
         cols: List[Column] = []

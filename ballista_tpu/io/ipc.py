@@ -24,7 +24,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..columnar import Column, ColumnBatch, Dictionary, round_capacity
+from ..columnar import Column, ColumnBatch, Dictionary
+from ..compile import bucket_capacity
 from ..datatypes import Field, Schema
 from ..errors import IoError
 
@@ -311,7 +312,9 @@ def batches_from_parts(
     out = []
     for pi, (arrays, nulls, dicts) in enumerate(parts):
         n = len(next(iter(arrays.values()))) if arrays else 0
-        cap = capacity or round_capacity(max(n, 1))
+        # shuffle-read batches enter at canonical ladder capacities:
+        # unevenly-sized shuffle partitions share compiled signatures
+        cap = capacity or bucket_capacity(max(n, 1))
         cols = []
         for f in schema.fields:
             if f.dtype.kind == "utf8":

@@ -13,7 +13,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..columnar import ColumnBatch, Dictionary, DEFAULT_BATCH_CAPACITY, round_capacity
+from ..columnar import ColumnBatch, Dictionary, DEFAULT_BATCH_CAPACITY
+from ..compile import bucket_capacity
 from ..datatypes import (
     Boolean,
     DataType,
@@ -163,7 +164,7 @@ class ParquetSource(TableSource):
                 arrays[name] = colarr.to_numpy(zero_copy_only=False).astype(
                     field.dtype.device_dtype()
                 )
-        cap = min(self._capacity, round_capacity(max(n, 1)))
+        cap = min(self._capacity, bucket_capacity(max(n, 1)))
         start = 0
         emitted = False
         while start < n or not emitted:

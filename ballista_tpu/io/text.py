@@ -22,7 +22,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..columnar import ColumnBatch, Dictionary, DEFAULT_BATCH_CAPACITY, round_capacity
+from ..columnar import ColumnBatch, Dictionary, DEFAULT_BATCH_CAPACITY
+from ..compile import bucket_capacity
 from ..datatypes import Schema
 from ..errors import IoError
 from ..logical import TableSource
@@ -343,7 +344,9 @@ class DelimitedSource(TableSource):
         """``force_emit`` guarantees at least one (possibly empty) batch;
         streaming callers emit per range and handle the empty-table case
         themselves."""
-        cap = min(self._capacity, round_capacity(max(n, 1)))
+        # scan batches enter at canonical ladder capacities so uneven
+        # files/partitions reuse a handful of compiled signatures
+        cap = min(self._capacity, bucket_capacity(max(n, 1)))
         start = 0
         emitted = not force_emit
         while start < n or not emitted:

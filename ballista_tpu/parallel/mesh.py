@@ -107,7 +107,12 @@ class MeshQueryRunner:
                 return out
             return jax.tree_util.tree_map(lambda x: x[None], out)
 
-        return jax.jit(run)(cols_dev, sel_dev), dicts
+        # deliberately NOT governed: device_fn is an arbitrary caller
+        # closure, so the only sound cache key is its identity — callers
+        # pass fresh lambdas, giving a 0% hit rate while the cache would
+        # pin the closures (and whatever they capture) process-wide. A
+        # transient jit matches the utility-API lifetime.
+        return jax.jit(run)(cols_dev, sel_dev), dicts  # jit-ok: transient
 
     # convenience: hash-repartition rows across the mesh ---------------------
 

@@ -75,50 +75,38 @@ def is_multiprocess() -> bool:
     return jax.process_count() > 1
 
 
-_jitted_max = None
-
-
 def host_max(arr) -> int:
     """max over a (possibly cross-process sharded) array, readable on
     every process. ``np.asarray`` on a global array whose shards live on
     other processes fails; a jitted max produces a replicated scalar
     every process holds locally. Works unchanged in single-process.
-    (One module-level jit so the retry hot paths hit its cache.)"""
-    global _jitted_max
-    if _jitted_max is None:
+    (One governed entry so the retry hot paths hit its cache.)"""
+    from ..compile import governed
+
+    def build():
         import jax.numpy as jnp
 
-        _jitted_max = jax.jit(jnp.max)
-    return int(_jitted_max(arr))
+        return jnp.max
 
-
-from collections import OrderedDict
-
-# bounded: keys hold identity-hashed per-query dictionaries via treedefs
-_REPLICATE_JITS: OrderedDict = OrderedDict()
-_REPLICATE_CAP = 32
+    return int(governed(("misc.host_max",), build)(arr))
 
 
 def replicate_stacked(stacked, mesh):
     """[n_dev, ...]-sharded pytree -> fully-replicated copy every
     process can read (an all_gather per leaf). Used to hand a fused
     stage's (small) final output to the group leader for
-    materialization."""
+    materialization. Bounded governed namespace: keys hold
+    identity-hashed per-query dictionaries via treedefs."""
     from functools import partial
 
     from jax.sharding import PartitionSpec as P
 
+    from ..compile import governed
     from .mesh import shard_map
 
     axis = mesh.axis_names[0]
-    key = (mesh, jax.tree.structure(stacked),
-           tuple(np.shape(x) for x in jax.tree.leaves(stacked)))
-    if key in _REPLICATE_JITS:
-        _REPLICATE_JITS.move_to_end(key)
-    else:
-        while len(_REPLICATE_JITS) >= _REPLICATE_CAP:
-            _REPLICATE_JITS.popitem(last=False)
 
+    def build():
         @partial(shard_map, mesh=mesh, in_specs=(P(axis),), out_specs=P(),
                  check_vma=False)
         def rep(st):
@@ -126,8 +114,13 @@ def replicate_stacked(stacked, mesh):
                 lambda x: jax.lax.all_gather(x[0], axis), st
             )
 
-        _REPLICATE_JITS[key] = jax.jit(rep)
-    return _REPLICATE_JITS[key](stacked)
+        return rep
+
+    from ..compile import MESH_NS_CAP
+
+    key = ("mesh.replicate", mesh, jax.tree.structure(stacked),
+           tuple(np.shape(x) for x in jax.tree.leaves(stacked)))
+    return governed(key, build, cap=MESH_NS_CAP)(stacked)
 
 
 def stack_local_to_global(slot_batches: Sequence, mesh):

@@ -101,7 +101,6 @@ class HashAggregateExec(PhysicalPlan):
         for name, a in self._aggs:
             if not isinstance(a, ex.AggregateExpr):
                 raise ExecutionError(f"not an aggregate expression: {name}")
-        self._jit_cache = {}
         self._ranged_rejected = False
         self._mixed_cache = None
         self._mixed_fingerprint = None
@@ -182,6 +181,12 @@ class HashAggregateExec(PhysicalPlan):
         g = ", ".join(e.name() for e in self.group_exprs)
         a = ", ".join(n for n, _ in self._aggs)
         return f"HashAggregateExec: mode={self.mode} gby=[{g}] aggr=[{a}]"
+
+    def _signature_parts(self) -> tuple:
+        from ..compile import fingerprint
+
+        return (self.mode, fingerprint(self.group_exprs),
+                fingerprint(self.agg_exprs), self._in_schema)
 
     # -- execution ----------------------------------------------------------
 
@@ -356,11 +361,12 @@ class HashAggregateExec(PhysicalPlan):
     def _mixed_stats(self, batch: ColumnBatch, layout):
         """(per-int-key (min, max) list, nlive): one jitted program,
         scalars only across the link."""
-        key = ("mstats", batch.capacity)
-        if key not in self._jit_cache:
+
+        def build():
+            tw = self.trace_twin()
 
             def stats(b):
-                kes, _ = self._inputs_and_keys(b)
+                kes, _ = tw._inputs_and_keys(b)
                 maxi = jnp.iinfo(jnp.int64).max
                 mm = []
                 for (kind, _), r in zip(layout, kes):
@@ -375,8 +381,10 @@ class HashAggregateExec(PhysicalPlan):
                                jnp.max(jnp.where(live, v, -maxi))))
                 return mm, jnp.sum(b.selection.astype(jnp.int32))
 
-            self._jit_cache[key] = jax.jit(stats)
-        mm, nlive = jax.device_get(self._jit_cache[key](batch))
+            return stats
+
+        fn = self.governed_jit(("agg.mstats", tuple(layout)), build)
+        mm, nlive = jax.device_get(fn(batch))
         return [(int(lo), int(hi)) for lo, hi in mm], int(nlive)
 
     def _exec_grouped(self, batch: ColumnBatch) -> ColumnBatch:
@@ -479,17 +487,20 @@ class HashAggregateExec(PhysicalPlan):
         )
 
     def _get_grouped_fn(self, cap: int, in_cap: int):
-        key = ("grouped", self.mode, cap, in_cap)
-        if key not in self._jit_cache:
+        def build():
+            tw = self.trace_twin()  # don't pin the input subtree
 
             def run(batch: ColumnBatch):
-                key_evals, aggs = self._inputs_and_keys(batch)
-                res = self._run_grouping(batch, key_evals, aggs, cap)
-                return self._assemble(batch, key_evals, res, cap), \
+                key_evals, aggs = tw._inputs_and_keys(batch)
+                res = tw._run_grouping(batch, key_evals, aggs, cap)
+                return tw._assemble(batch, key_evals, res, cap), \
                     res.num_groups
 
-            self._jit_cache[key] = jax.jit(run)
-        return self._jit_cache[key]
+            return run
+
+        # in_cap rides the traced batch shape; only the static group
+        # capacity needs to be in the key
+        return self.governed_jit(("agg.grouped", cap), build)
 
     def _get_mixed_fn(self, spans, in_cap: int, layout):
         """Grouping program for mixed dict/ranged-int keys: mixed-radix
@@ -498,8 +509,8 @@ class HashAggregateExec(PhysicalPlan):
         are a traced argument so consecutive batches with different
         ranges but the same quantized spans reuse one compiled
         program."""
-        key = ("mixed", self.mode, spans, in_cap)
-        if key not in self._jit_cache:
+        def build():
+            tw = self.trace_twin()
             g_total = 1
             for s in spans:
                 g_total *= s
@@ -509,7 +520,7 @@ class HashAggregateExec(PhysicalPlan):
             G = round_capacity(g_total)
 
             def run(batch: ColumnBatch, bases):
-                key_evals, aggs = self._inputs_and_keys(batch)
+                key_evals, aggs = tw._inputs_and_keys(batch)
                 gid = jnp.zeros((batch.capacity,), jnp.int64)
                 bi = 0
                 for (kind, _), span, r in zip(layout, spans, key_evals):
@@ -524,11 +535,12 @@ class HashAggregateExec(PhysicalPlan):
                     gid = gid * span + c
                 res = dense_grouped_scatter(gid.astype(jnp.int32),
                                             batch.selection, aggs, G)
-                return self._assemble(batch, key_evals, res, G), \
+                return tw._assemble(batch, key_evals, res, G), \
                     res.num_groups
 
-            self._jit_cache[key] = jax.jit(run)
-        return self._jit_cache[key]
+            return run
+
+        return self.governed_jit(("agg.mixed", spans, tuple(layout)), build)
 
     def _finalize(self, res) -> List[Column]:
         """final mode: merge states -> output aggregate columns."""
@@ -565,18 +577,19 @@ class HashAggregateExec(PhysicalPlan):
     # ungrouped -------------------------------------------------------------
 
     def _exec_scalar(self, batch: ColumnBatch) -> ColumnBatch:
-        key = ("scalar", self.mode, batch.capacity)
-        if key not in self._jit_cache:
+        def build():
+            tw = self.trace_twin()
 
             def run(b: ColumnBatch):
-                if self.mode == "partial":
-                    aggs = self._agg_inputs_partial(b)
+                if tw.mode == "partial":
+                    aggs = tw._agg_inputs_partial(b)
                 else:
-                    aggs = self._agg_inputs_final(b)
+                    aggs = tw._agg_inputs_final(b)
                 return scalar_aggregate(b.selection, aggs)
 
-            self._jit_cache[key] = jax.jit(run)
-        vals, valids = self._jit_cache[key](batch)
+            return run
+
+        vals, valids = self.governed_jit(("agg.scalar",), build)(batch)
 
         cap = 8
         sel = np.zeros(cap, dtype=bool)

@@ -19,9 +19,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..columnar import Column, ColumnBatch
+from ..compile import bucket_capacity, governed
 from ..datatypes import Schema
 from ..errors import ExecutionError
-from ..observability.metrics import MetricsSet, instrument_execute
+from ..observability.metrics import (MetricsSet, instrument_execute,
+                                     metrics_enabled)
 
 
 @dataclass(frozen=True)
@@ -59,6 +61,68 @@ class PhysicalPlan:
         if m is None:
             m = self._metrics = MetricsSet()
         return m
+
+    # -- compile governor ---------------------------------------------------
+
+    def compile_signature(self) -> tuple:
+        """Value-signature of everything this operator's traced closures
+        read from instance state. Governed jit keys include it, so two
+        instances with equal signatures (e.g. the same operator before
+        and after an adaptive re-plan) share one compiled entry. The
+        default covers operators whose ``display()`` renders their full
+        configuration; operators with trace-relevant state beyond that
+        override ``_signature_parts``."""
+        sig = getattr(self, "_compile_sig", None)
+        if sig is None:
+            sig = self._compile_sig = (
+                (type(self).__name__,) + self._signature_parts()
+            )
+        return sig
+
+    def _signature_parts(self) -> tuple:
+        return (self.display(), self.output_schema())
+
+    def governed_jit(self, subkey: tuple, build, **kw):
+        """Process-wide compiled function for this operator under
+        ``subkey`` (namespace first); compiles it triggers are
+        attributed to this operator's metrics. Replaces the per-instance
+        ``self._jit_*`` dicts, which adaptive re-planning (new operator
+        instances) used to throw away."""
+        key = (subkey[0], self.compile_signature()) + tuple(subkey[1:])
+        metrics = self.metrics() if metrics_enabled() else None
+        return governed(key, build, metrics=metrics, **kw)
+
+    def trace_twin(self) -> "PhysicalPlan":
+        """Config-only shallow clone for governed closures to capture.
+
+        Governed entries outlive operator instances, so a closure over
+        ``self`` would pin the whole plan subtree — cached scan batches,
+        repartition materializations, join build-side device buffers —
+        for as long as the compiled entry lives. The twin carries
+        everything traced closures actually read (mode/exprs/schemas/
+        evaluators) while ``_detach`` severs children and data caches.
+        Closures passed to ``governed_jit`` must reference the twin,
+        never ``self``."""
+        tw = getattr(self, "_trace_twin", None)
+        if tw is None:
+            import copy
+
+            tw = copy.copy(self)
+            self._trace_twin = tw
+            tw._trace_twin = tw  # twin of the twin is itself
+            tw._metrics = None
+            tw._detach()
+        return tw
+
+    def _detach(self) -> None:
+        """Sever plan-subtree and materialized-state references on a
+        trace twin (runs on the COPY). Default: children become
+        schema-only leaves. Operators whose traced closures read other
+        heavy members override and extend."""
+        if getattr(self, "child", None) is not None:
+            self.child = SchemaLeaf(self.child.output_schema())
+        if getattr(self, "_fused_fn", None) is not None:
+            self._fused_fn = None  # no entry->twin->entry cycles
 
     def output_schema(self) -> Schema:
         raise NotImplementedError
@@ -111,6 +175,21 @@ class PhysicalPlan:
         return out
 
 
+class SchemaLeaf(PhysicalPlan):
+    """Schema-only placeholder standing in for a severed child on a
+    trace twin (mirrors mesh_agg's _SchemaOnly, but importable from
+    base without cycles). Never executed."""
+
+    def __init__(self, schema: Schema):
+        self._schema = schema
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def with_new_children(self, children):
+        return self
+
+
 class PipelineOp(PhysicalPlan):
     """Operator whose work is a pure batch->batch device transform.
 
@@ -145,22 +224,37 @@ class PipelineOp(PhysicalPlan):
         chain.reverse()  # innermost transform first
         return chain, node
 
-    def execute(self, partition: int) -> Iterator[ColumnBatch]:
-        import time as _time
-
-        chain, source = self._pipeline_chain()
+    def _fused_governed(self):
+        """Governed fused transform for this operator's pipeline chain.
+        Keyed on the chain's operator signatures, so a re-planned stage
+        (fresh instances, same logical chain) reuses the compiled
+        programs; compile time lands on this operator's metrics."""
         fused = getattr(self, "_fused_fn", None)
-        first_call = False
         if fused is None:
+            chain, _ = self._pipeline_chain()
 
-            def apply_all(batch):
-                for op in chain:
-                    batch = op.device_transform(batch)
-                return batch
+            def build():
+                # twins: device_transform reads exprs/evaluators, never
+                # .child — capturing the live ops would pin the source
+                # (and its cached batches) in the process-wide cache
+                twins = [op.trace_twin() for op in chain]
 
-            fused = jax.jit(apply_all)
-            self._fused_fn = fused
-            first_call = True
+                def apply_all(batch):
+                    for op in twins:
+                        batch = op.device_transform(batch)
+                    return batch
+
+                return apply_all
+
+            key = ("pipeline.fused",
+                   tuple(op.compile_signature() for op in chain))
+            metrics = self.metrics() if metrics_enabled() else None
+            fused = self._fused_fn = governed(key, build, metrics=metrics)
+        return fused
+
+    def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        chain, source = self._pipeline_chain()
+        fused = self._fused_governed()
         # Adaptive: a filter's selectivity is stationary within a query,
         # so after 2 consecutive batches that decline to compact, stop
         # paying the per-batch live-count sync for the operator's
@@ -178,19 +272,10 @@ class PipelineOp(PhysicalPlan):
         # thrash; the same policy covers the MetricsSet counters below.
         compact = any(op.compactable for op in chain)
         for batch in source.execute(partition):
-            if first_call:
-                # first fused call pays the XLA compile; record it as
-                # the operator's compile-vs-execute split (upper bound:
-                # the measurement includes the first batch's execution,
-                # but compile dominates by orders of magnitude when the
-                # persistent XLA cache misses)
-                t0 = _time.perf_counter()
-                out = fused(batch)
-                self.metrics().add_time("elapsed_compile",
-                                        _time.perf_counter() - t0)
-                first_call = False
-            else:
-                out = fused(batch)
+            # the governor records the compile-vs-execute split: a call
+            # that triggers an XLA compile lands its duration on this
+            # operator's elapsed_compile / compile_count metrics
+            out = fused(batch)
             if compact and getattr(self, "_compact_misses", 0) < 2:
                 res = maybe_compact(
                     out, floor=getattr(self, "_compact_floor", 8))
@@ -218,6 +303,16 @@ def concat_batches(schema: Schema, batches: List[ColumnBatch]) -> ColumnBatch:
     partitions from independent producers) are unified: a sorted union
     dictionary is built host-side and each batch's codes are remapped.
     Host-level only — never call inside a jit trace.
+
+    Output capacity is the exact SUM of the inputs, deliberately NOT
+    padded up to a bucket-ladder rung: inputs are already ladder-sized,
+    so concat capacities quantize to rung sums (e.g. k * 2^20 for a
+    k-chunk scan) — a bounded shape family — while padding to the next
+    rung would make the downstream sort/aggregate touch up to ~2x the
+    rows (q1's 6-chunk concat would grow 6M -> 8.4M), blowing the warm-
+    throughput budget for a marginal compile saving. RepartitionExec is
+    the exception (it pads): its fragment concats produce genuinely
+    irregular sums across partitions of one shuffle.
     """
     if not batches:
         raise ExecutionError("concat of zero batches")
@@ -270,8 +365,6 @@ def concat_batches(schema: Schema, batches: List[ColumnBatch]) -> ColumnBatch:
     return ColumnBatch(schema, cols, selection, num_rows)
 
 
-_COMPACT_JITS: dict = {}
-
 # Measured cost of a blocking scalar device->host read (seconds). When the
 # accelerator is remote (e.g. tunneled), one sync costs a network
 # round-trip — far more than speculative compaction ever saves — so
@@ -303,8 +396,6 @@ def maybe_compact(batch: ColumnBatch, shrink_factor: int = 4,
     blocks. Without it, the live-count sync is only paid while measured
     sync cost is low; on a remote accelerator the first call measures
     the round-trip and all later speculative syncs are skipped."""
-    from ..columnar import round_capacity
-
     if known_rows is not None:
         n = known_rows
     else:
@@ -315,19 +406,21 @@ def maybe_compact(batch: ColumnBatch, shrink_factor: int = 4,
         if first:
             _record_sync_cost(batch)  # pure-RTT measurement
     cap = batch.capacity
-    new_cap = max(round_capacity(n), floor, 8)
+    # compaction targets land on the bucket ladder: a selective filter's
+    # survivors must not mint a fresh per-selectivity capacity downstream
+    new_cap = max(bucket_capacity(n), floor, 8)
     if new_cap * shrink_factor > cap:
         return batch
-    key = (cap, new_cap)
-    if key not in _COMPACT_JITS:
 
-        def compact(b: ColumnBatch, _new=new_cap) -> ColumnBatch:
+    def build(_new=new_cap):
+        def compact(b: ColumnBatch) -> ColumnBatch:
             perm = compact_perm(b.selection, _new)
             live = jnp.arange(_new, dtype=jnp.int32) < b.num_rows
             return take_batch(b, perm, live)
 
-        _COMPACT_JITS[key] = jax.jit(compact)
-    return _COMPACT_JITS[key](batch)
+        return compact
+
+    return governed(("batch.compact", new_cap), build)(batch)
 
 
 def pad_batch(batch: ColumnBatch, capacity: int) -> ColumnBatch:

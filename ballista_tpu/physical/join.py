@@ -26,9 +26,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..columnar import Column, ColumnBatch, round_capacity
+from ..compile import bucket_capacity, governed
 from ..datatypes import Schema
 from ..errors import ExecutionError, NotImplementedError_
 from ..kernels import join as join_k
+from ..observability.metrics import metrics_enabled
 from .base import PhysicalPlan, Partitioning, concat_batches
 
 JOIN_TYPES = ("inner", "left", "semi", "anti", "full")
@@ -68,9 +70,22 @@ class JoinExec(PhysicalPlan):
         # partition -> (table, batch, unique, has_null, key mode,
         #               codec tables, build keys, build live)
         self._build_data = {}
-        self._jit_probe = {}
-        self._jit_codec_build = {}
         self._remap_cache = {}
+
+    def _signature_parts(self) -> tuple:
+        # partitioned/adaptive_note steer HOST orchestration only — no
+        # traced closure reads them, so a demoted (adaptive) join reuses
+        # the original join's compiled probes
+        return (self.how, tuple(self.on), self.null_aware,
+                self.build.output_schema(), self.probe.output_schema())
+
+    def _detach(self) -> None:
+        from .base import SchemaLeaf
+
+        self.build = SchemaLeaf(self.build.output_schema())
+        self.probe = SchemaLeaf(self.probe.output_schema())
+        self._build_data = {}   # materialized build-side device buffers
+        self._remap_cache = {}  # per-query dictionaries
 
     # -- composite keys ------------------------------------------------------
     #
@@ -118,8 +133,10 @@ class JoinExec(PhysicalPlan):
         device for the build to reuse (it is exactly the
         selection & key-validity reduction the raw/packed paths need)."""
 
+        tw = self.trace_twin()
+
         def stats(bb):
-            live_ext = self._key_live_ext(bb, cols)
+            live_ext = tw._key_live_ext(bb, cols)
             live = bb.selection
             if live_ext is not None:
                 live = jnp.logical_and(live, live_ext)
@@ -141,10 +158,8 @@ class JoinExec(PhysicalPlan):
             out["live_max"] = jnp.max(jnp.where(live, v0, -maxi))
             return out, live
 
-        key = ("stats", bb.capacity)
-        if key not in self._jit_probe:
-            self._jit_probe[key] = jax.jit(stats)
-        scalars, live = self._jit_probe[key](bb)
+        fn = self.governed_jit(("join.stats",), lambda: stats)
+        scalars, live = fn(bb)
         return jax.device_get(scalars), live
 
     def _pick_mode(self, stats, ncols: int) -> str:
@@ -295,11 +310,11 @@ class JoinExec(PhysicalPlan):
             live = stats_live
             key_tables = ()
         else:
-            if bb.capacity not in self._jit_codec_build:
-                self._jit_codec_build[bb.capacity] = jax.jit(
-                    lambda b: self._codec_build(b, bcols)
-                )
-            keys, live, key_tables = self._jit_codec_build[bb.capacity](bb)
+            codec_fn = self.governed_jit(
+                ("join.codec_build",),
+                lambda: (lambda b, _tw=self.trace_twin():
+                         _tw._codec_build(b, bcols)))
+            keys, live, key_tables = codec_fn(bb)
         table = None
         unique = True
         if mode == "raw" and nlive > 0:
@@ -311,23 +326,24 @@ class JoinExec(PhysicalPlan):
                 # with different key ranges reuse one compiled program;
                 # padding slots stay -1 and can never match
                 size = round_capacity(size)
-                jkey = ("dense", bb.capacity, size)
-                if jkey not in self._jit_probe:
-                    self._jit_probe[jkey] = jax.jit(
-                        join_k.build_dense, static_argnames=("size",))
-                rows, dup = self._jit_probe[jkey](keys, live,
-                                                  jnp.int64(base), size=size)
+                # operator-independent kernel: key WITHOUT the join
+                # signature so every join shares one compiled entry
+                # (metrics still bind to this operator)
+                dense_fn = governed(
+                    ("join.dense",), lambda: join_k.build_dense,
+                    metrics=self.metrics() if metrics_enabled() else None,
+                    jit_kwargs={"static_argnames": ("size",)})
+                rows, dup = dense_fn(keys, live, jnp.int64(base), size=size)
                 if not bool(dup):
                     table = join_k.BuildTable(
                         sorted_keys=None, order=None,
                         num_live=jnp.asarray(nlive, jnp.int32),
                         dense_rows=rows, dense_base=jnp.int64(base))
         if table is None:
-            jkey = ("sorted", bb.capacity)
-            if jkey not in self._jit_probe:
-                self._jit_probe[jkey] = jax.jit(
-                    join_k.build_sorted_with_unique)
-            table, uniq = self._jit_probe[jkey](keys, live)
+            sorted_fn = governed(
+                ("join.sorted",), lambda: join_k.build_sorted_with_unique,
+                metrics=self.metrics() if metrics_enabled() else None)
+            table, uniq = sorted_fn(keys, live)
             unique = bool(uniq)
         self._build_data[key] = (table, bb, unique, has_null_key, mode,
                                  key_tables, keys, live)
@@ -405,17 +421,19 @@ class JoinExec(PhysicalPlan):
         NOTE: redoes the probe-key extraction the main pass already did;
         folding a build_rows scatter into the probe jits would halve the
         full-join probe cost if it ever shows up in profiles."""
-        key = ("m", mode, pb.capacity, build_batch.capacity)
-        if key not in self._jit_probe:
+        def build():
+            tw = self.trace_twin()
 
             def run(pb, key_tables, remaps, bkeys, blive):
-                pkeys, plive = self._probe_keys(pb, mode, key_tables, remaps)
+                pkeys, plive = tw._probe_keys(pb, mode, key_tables, remaps)
                 pt = join_k.build_lookup(pkeys, plive)
                 _, matched = join_k.probe_unique(pt, bkeys, blive)
                 return jnp.logical_and(blive, matched)
 
-            self._jit_probe[key] = jax.jit(run)
-        return self._jit_probe[key](pb, key_tables, remaps, bkeys, blive)
+            return run
+
+        fn = self.governed_jit(("join.mark", mode), build)
+        return fn(pb, key_tables, remaps, bkeys, blive)
 
     def _unmatched_build_batch(self, bb: ColumnBatch,
                                unmatched) -> ColumnBatch:
@@ -534,19 +552,20 @@ class JoinExec(PhysicalPlan):
 
     def _probe_unique_batch(self, table, build_batch, pb: ColumnBatch,
                             mode: str, key_tables, remaps) -> ColumnBatch:
-        key = ("u", mode, pb.capacity, build_batch.capacity)
-        if key not in self._jit_probe:
+        def build():
+            tw = self.trace_twin()
 
             def run(table, bb: ColumnBatch, pb: ColumnBatch,
                     key_tables, remaps) -> ColumnBatch:
-                pkeys, plive = self._probe_keys(pb, mode, key_tables, remaps)
+                pkeys, plive = tw._probe_keys(pb, mode, key_tables, remaps)
                 build_rows, matched = join_k.probe_unique(table, pkeys, plive)
-                return self._assemble(bb, pb, build_rows, matched,
-                                      pb.selection, None)
+                return tw._assemble(bb, pb, build_rows, matched,
+                                    pb.selection, None)
 
-            self._jit_probe[key] = jax.jit(run)
-        return self._jit_probe[key](table, build_batch, pb, key_tables,
-                                    remaps)
+            return run
+
+        fn = self.governed_jit(("join.unique", mode), build)
+        return fn(table, build_batch, pb, key_tables, remaps)
 
     # general path: expanding probe -----------------------------------------
 
@@ -554,32 +573,33 @@ class JoinExec(PhysicalPlan):
                     out_cap: int):
         """One async expanding-probe launch at a fixed output capacity.
         Returns (out_batch, total_matches_device) WITHOUT syncing."""
-        key = ("e", mode, pb.capacity, build_batch.capacity, out_cap)
-        if key not in self._jit_probe:
+        def build():
+            tw = self.trace_twin()
 
             def run(table, bb, pb, key_tables, remaps, _cap=out_cap):
-                pkeys, plive = self._probe_keys(pb, mode, key_tables,
-                                                remaps)
+                pkeys, plive = tw._probe_keys(pb, mode, key_tables,
+                                              remaps)
                 prows, brows, olive, total = join_k.probe_expand(
                     table, pkeys, plive, _cap
                 )
-                out = self._assemble_expanded(bb, pb, prows, brows, olive)
+                out = tw._assemble_expanded(bb, pb, prows, brows, olive)
                 return out, total
 
-            self._jit_probe[key] = jax.jit(run)
-        return self._jit_probe[key](table, build_batch, pb, key_tables,
-                                    remaps)
+            return run
+
+        fn = self.governed_jit(("join.expand", mode, out_cap), build)
+        return fn(table, build_batch, pb, key_tables, remaps)
 
     def _unmatched_batch(self, table, build_batch, pb, mode, key_tables,
                          remaps) -> ColumnBatch:
         """left/full: preserved probe rows with no match, null build
         columns. Pure device work — no sync."""
-        key = ("l", mode, pb.capacity, build_batch.capacity)
-        if key not in self._jit_probe:
+        def build():
+            tw = self.trace_twin()
 
             def run_unmatched(table, bb, pb, key_tables, remaps):
-                pkeys, plive = self._probe_keys(pb, mode, key_tables,
-                                                remaps)
+                pkeys, plive = tw._probe_keys(pb, mode, key_tables,
+                                              remaps)
                 counts = join_k.probe_counts(table, pkeys)
                 unmatched = jnp.logical_and(pb.selection,
                                             jnp.logical_or(
@@ -587,12 +607,13 @@ class JoinExec(PhysicalPlan):
                                                 counts == 0))
                 zero = jnp.zeros((pb.capacity,), jnp.int32)
                 no_match = jnp.zeros((pb.capacity,), jnp.bool_)
-                return self._assemble(bb, pb, zero, no_match, unmatched,
-                                      None)
+                return tw._assemble(bb, pb, zero, no_match, unmatched,
+                                    None)
 
-            self._jit_probe[key] = jax.jit(run_unmatched)
-        return self._jit_probe[key](table, build_batch, pb, key_tables,
-                                    remaps)
+            return run_unmatched
+
+        fn = self.governed_jit(("join.unmatched", mode), build)
+        return fn(table, build_batch, pb, key_tables, remaps)
 
     def _probe_expand_batch(self, table, build_batch, pb, mode,
                             key_tables) -> Iterator[ColumnBatch]:
@@ -645,9 +666,9 @@ class JoinExec(PhysicalPlan):
             totals = jax.device_get([p[-1] for p in pend])  # ONE sync
             for (pb, remaps, out, out_cap, _), total in zip(pend, totals):
                 t = int(total)
-                while t > out_cap:  # rare: re-run at the exact capacity
+                while t > out_cap:  # rare: re-run at a ladder capacity
                     self.metrics().add_counter("expand_reruns")
-                    out_cap = round_capacity(t)
+                    out_cap = bucket_capacity(t)
                     out, tot = self._expand_run(
                         table, build_batch, pb, mode, key_tables, remaps,
                         out_cap)

@@ -31,6 +31,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..columnar import Column, ColumnBatch, round_capacity
+from ..compile import bucket_capacity
 from ..datatypes import Schema
 from ..errors import ExecutionError
 from .. import expr as ex
@@ -136,6 +137,20 @@ class MeshAggExec(PhysicalPlan):
         return (f"MeshAggExec: {self.n_devices}-device ICI all_to_all "
                 f"shuffle + final agg gby=[{g}]")
 
+    def _signature_parts(self) -> tuple:
+        from ..compile import fingerprint
+
+        return (fingerprint(self.group_exprs), fingerprint(self.agg_exprs),
+                fingerprint(self.hash_exprs), self.n_devices,
+                self._partial_schema)
+
+    def _detach(self) -> None:
+        from .base import SchemaLeaf
+
+        # _final's child is already schema-only; only the producer
+        # subtree (scans and their caches) must be severed
+        self.producer = SchemaLeaf(self._partial_schema)
+
     # -- execution -----------------------------------------------------------
 
     def _spmd(self, stacked, mesh, cap: int, in_cap: int):
@@ -144,17 +159,20 @@ class MeshAggExec(PhysicalPlan):
 
         from ..parallel.mesh import shard_map  # version-guarded import
 
+        from ..compile import governed
+        from .mesh_input import _MESH_NS_CAP
+
         n_dev = self.n_devices
-        cache = self.__dict__.setdefault("_spmd_jit", {})
-        key = (mesh, cap, in_cap, jax.tree.structure(stacked))
-        if key not in cache:
+
+        def build():
+            tw = self.trace_twin()
             final_fn = self._final._get_grouped_fn(cap, n_dev * in_cap)
 
             @partial(shard_map, mesh=mesh, in_specs=(P("data"),),
                      out_specs=(P("data"), P("data")), check_vma=False)
             def run(stacked_b):
                 b = jax.tree.map(lambda x: x[0], stacked_b)
-                b2 = _shuffle_side(b, self.hash_exprs, self._ev, n_dev,
+                b2 = _shuffle_side(b, tw.hash_exprs, tw._ev, n_dev,
                                    in_cap)
                 out_batch, num_groups = final_fn(b2)
                 return (
@@ -162,8 +180,12 @@ class MeshAggExec(PhysicalPlan):
                     num_groups[None],
                 )
 
-            cache[key] = jax.jit(run)
-        return cache[key](stacked)
+            return run
+
+        key = ("mesh.agg_spmd", self.compile_signature(), mesh, cap,
+               in_cap, jax.tree.structure(stacked))
+        return governed(key, build, cap=_MESH_NS_CAP,
+                        metrics=self.metrics())(stacked)
 
     def execute_stacked(self, mesh) -> ColumnBatch:
         """Device-resident execution: stacked [n_dev, cap] output sharded
@@ -259,6 +281,21 @@ class MeshJoinExec(PhysicalPlan):
         return (f"MeshJoinExec: {self.n_devices}-device ICI all_to_all "
                 f"join how={self.how} on=[{on}]")
 
+    def _signature_parts(self) -> tuple:
+        return (self.how, tuple(self.on), self.null_aware, self.n_devices,
+                self.build_producer.output_schema(),
+                self.probe_producer.output_schema())
+
+    def _detach(self) -> None:
+        from .base import SchemaLeaf
+
+        self.build_producer = SchemaLeaf(self.build_producer.output_schema())
+        self.probe_producer = SchemaLeaf(self.probe_producer.output_schema())
+        # _join's children are already schema-only, but execute_stacked
+        # fills its _remap_cache with per-query dictionaries — take its
+        # own (cache-cleared) twin so the governed entry pins none
+        self._join = self._join.trace_twin()
+
     # -- execution -----------------------------------------------------------
 
     def _spmd(self, stacked_b, stacked_p, mesh, remaps, out_cap: int,
@@ -268,146 +305,152 @@ class MeshJoinExec(PhysicalPlan):
         from ..kernels import join as join_k
         from ..parallel.mesh import shard_map
 
-        n_dev = self.n_devices
-        bcols = [b for b, _ in self.on]
-        pcols = [p for _, p in self.on]
-        bhash = [ex.ColumnRef(c) for c in bcols]
-        phash = [ex.ColumnRef(c) for c in pcols]
-        out_schema = self.output_schema()
-        probe_schema = self.probe_producer.output_schema()
+        def build():
+            # whole closure construction deferred: on a governed cache hit
+            # none of this work (twin, hash exprs, shard_map wrapping) runs
+            n_dev = self.n_devices
+            bcols = [b for b, _ in self.on]
+            pcols = [p for _, p in self.on]
+            bhash = [ex.ColumnRef(c) for c in bcols]
+            phash = [ex.ColumnRef(c) for c in pcols]
+            out_schema = self.output_schema()
+            probe_schema = self.probe_producer.output_schema()
+            tw = self.trace_twin()
 
-        cache = self.__dict__.setdefault("_spmd_jit", {})
-        key = (mesh, out_cap, b_cap, p_cap,
+            @fpartial(shard_map, mesh=mesh,
+                      in_specs=(P("data"), P("data"), P()),
+                      out_specs=(P("data"), P("data")), check_vma=False)
+            def run(sb, sp, remaps):
+              b = jax.tree.map(lambda x: x[0], sb)
+              p = jax.tree.map(lambda x: x[0], sp)
+              b2 = _shuffle_side(b, bhash, tw._build_ev, n_dev, b_cap)
+              p2 = _shuffle_side(p, phash, tw._probe_ev, n_dev, p_cap)
+              # keys: raw for a single column, exact rank codec otherwise
+              if len(tw.on) == 1:
+                  bk = b2.column(bcols[0]).values.astype(jnp.int64)
+                  blive = b2.selection
+                  v = b2.column(bcols[0]).validity
+                  if v is not None:
+                      blive = jnp.logical_and(blive, v)
+                  pk, pvalid = tw._join._probe_col_values(
+                      p2, pcols[0], remaps[0])
+                  plive = p2.selection
+                  if pvalid is not None:
+                      plive = jnp.logical_and(plive, pvalid)
+              else:
+                  bk, blive, (tables, nlive) = tw._join._codec_build(
+                      b2, bcols)
+                  pk, plive = tw._join._probe_keys(p2, "codec",
+                                                     (tables, nlive), remaps)
+              table = join_k.build_lookup(bk, blive)
+
+              if tw.how in ("semi", "anti"):
+                  # membership only: probe-aligned output, no expansion
+                  matched = join_k.probe_semi(table, pk, plive)
+                  if tw.how == "semi":
+                      sel = jnp.logical_and(p2.selection, matched)
+                  else:
+                      sel = jnp.logical_and(p2.selection,
+                                            jnp.logical_not(matched))
+                      if tw.null_aware:
+                          # SQL NOT IN: a null key ANYWHERE in the build
+                          # side (any device) makes the predicate never
+                          # true; null-key probe rows are dropped too
+                          bnull = jnp.logical_and(b2.selection,
+                                                  jnp.logical_not(blive))
+                          bnull_any = jax.lax.pmax(
+                              jnp.max(bnull.astype(jnp.int32)), "data") > 0
+                          for _, pcol in tw.on:
+                              vv = p2.column(pcol).validity
+                              if vv is not None:
+                                  sel = jnp.logical_and(sel, vv)
+                          sel = jnp.logical_and(sel,
+                                                jnp.logical_not(bnull_any))
+                  out = p2.with_selection(sel)
+                  need = jnp.zeros((), jnp.int32)
+                  return jax.tree.map(lambda x: x[None], out), need[None]
+
+              prows, brows, olive, total = join_k.probe_expand(
+                  table, pk, plive, out_cap)
+              need = total
+              C = out_cap
+              # outer rows: co-partitioning makes unmatched detection
+              # local; append them after the matched expansion in the same
+              # static buffer (overflow rides the same retry as matches)
+              sidx_p = sidx_b = None
+              n_up = jnp.zeros((), jnp.int32)
+              if tw.how in ("left", "full"):
+                  counts = join_k.probe_counts(table, pk)
+                  un_p = jnp.logical_and(
+                      p2.selection,
+                      jnp.logical_or(jnp.logical_not(plive), counts == 0))
+                  rank_p = jnp.cumsum(un_p.astype(jnp.int32)) - un_p
+                  n_up = jnp.sum(un_p.astype(jnp.int32))
+                  sidx_p = jnp.where(un_p, total + rank_p, C)  # C drops
+                  need = need + n_up
+              if tw.how == "full":
+                  pt = join_k.build_lookup(pk, plive)
+                  _, bmat = join_k.probe_unique(pt, bk, blive)
+                  un_b = jnp.logical_and(
+                      b2.selection,
+                      jnp.logical_not(jnp.logical_and(blive, bmat)))
+                  rank_b = jnp.cumsum(un_b.astype(jnp.int32)) - un_b
+                  sidx_b = jnp.where(un_b, total + n_up + rank_b, C)
+                  need = need + jnp.sum(un_b.astype(jnp.int32))
+
+              live = olive
+              if sidx_p is not None:
+                  live = live.at[sidx_p].set(True, mode="drop")
+              if sidx_b is not None:
+                  live = live.at[sidx_b].set(True, mode="drop")
+
+              cols = []
+              for f in out_schema.fields:
+                  from_probe = probe_schema.has_field(f.name)
+                  src = p2 if from_probe else b2
+                  rows = prows if from_probe else brows
+                  c = src.column(f.name)
+                  vals = jnp.take(c.values, rows)
+                  validity = (jnp.take(c.validity, rows)
+                              if c.validity is not None else None)
+                  src_valid = (c.validity if c.validity is not None
+                               else True)
+                  if from_probe:
+                      if sidx_p is not None:
+                          vals = vals.at[sidx_p].set(c.values, mode="drop")
+                          if validity is not None:
+                              validity = validity.at[sidx_p].set(
+                                  src_valid, mode="drop")
+                      if sidx_b is not None:  # probe cols null on
+                          if validity is None:  # build-only rows
+                              validity = jnp.ones((C,), jnp.bool_)
+                          validity = validity.at[sidx_b].set(
+                              False, mode="drop")
+                  else:
+                      if sidx_p is not None:  # build cols null on
+                          if validity is None:  # probe-only rows
+                              validity = jnp.ones((C,), jnp.bool_)
+                          validity = validity.at[sidx_p].set(
+                              False, mode="drop")
+                      if sidx_b is not None:
+                          vals = vals.at[sidx_b].set(c.values, mode="drop")
+                          validity = validity.at[sidx_b].set(
+                              src_valid, mode="drop")
+                  cols.append(Column(vals, f.dtype, validity, c.dictionary))
+              out = ColumnBatch(out_schema, cols, live,
+                                jnp.sum(live).astype(jnp.int32))
+              return jax.tree.map(lambda x: x[None], out), need[None]
+
+            return run
+
+        from ..compile import MESH_NS_CAP, governed
+
+        key = ("mesh.join_spmd", self.compile_signature(), mesh, out_cap,
+               b_cap, p_cap,
                jax.tree.structure((stacked_b, stacked_p, remaps)))
-        if key in cache:
-            return cache[key](stacked_b, stacked_p, remaps)
-
-        @fpartial(shard_map, mesh=mesh,
-                  in_specs=(P("data"), P("data"), P()),
-                  out_specs=(P("data"), P("data")), check_vma=False)
-        def run(sb, sp, remaps):
-            b = jax.tree.map(lambda x: x[0], sb)
-            p = jax.tree.map(lambda x: x[0], sp)
-            b2 = _shuffle_side(b, bhash, self._build_ev, n_dev, b_cap)
-            p2 = _shuffle_side(p, phash, self._probe_ev, n_dev, p_cap)
-            # keys: raw for a single column, exact rank codec otherwise
-            if len(self.on) == 1:
-                bk = b2.column(bcols[0]).values.astype(jnp.int64)
-                blive = b2.selection
-                v = b2.column(bcols[0]).validity
-                if v is not None:
-                    blive = jnp.logical_and(blive, v)
-                pk, pvalid = self._join._probe_col_values(
-                    p2, pcols[0], remaps[0])
-                plive = p2.selection
-                if pvalid is not None:
-                    plive = jnp.logical_and(plive, pvalid)
-            else:
-                bk, blive, (tables, nlive) = self._join._codec_build(
-                    b2, bcols)
-                pk, plive = self._join._probe_keys(p2, "codec",
-                                                   (tables, nlive), remaps)
-            table = join_k.build_lookup(bk, blive)
-
-            if self.how in ("semi", "anti"):
-                # membership only: probe-aligned output, no expansion
-                matched = join_k.probe_semi(table, pk, plive)
-                if self.how == "semi":
-                    sel = jnp.logical_and(p2.selection, matched)
-                else:
-                    sel = jnp.logical_and(p2.selection,
-                                          jnp.logical_not(matched))
-                    if self.null_aware:
-                        # SQL NOT IN: a null key ANYWHERE in the build
-                        # side (any device) makes the predicate never
-                        # true; null-key probe rows are dropped too
-                        bnull = jnp.logical_and(b2.selection,
-                                                jnp.logical_not(blive))
-                        bnull_any = jax.lax.pmax(
-                            jnp.max(bnull.astype(jnp.int32)), "data") > 0
-                        for _, pcol in self.on:
-                            vv = p2.column(pcol).validity
-                            if vv is not None:
-                                sel = jnp.logical_and(sel, vv)
-                        sel = jnp.logical_and(sel,
-                                              jnp.logical_not(bnull_any))
-                out = p2.with_selection(sel)
-                need = jnp.zeros((), jnp.int32)
-                return jax.tree.map(lambda x: x[None], out), need[None]
-
-            prows, brows, olive, total = join_k.probe_expand(
-                table, pk, plive, out_cap)
-            need = total
-            C = out_cap
-            # outer rows: co-partitioning makes unmatched detection
-            # local; append them after the matched expansion in the same
-            # static buffer (overflow rides the same retry as matches)
-            sidx_p = sidx_b = None
-            n_up = jnp.zeros((), jnp.int32)
-            if self.how in ("left", "full"):
-                counts = join_k.probe_counts(table, pk)
-                un_p = jnp.logical_and(
-                    p2.selection,
-                    jnp.logical_or(jnp.logical_not(plive), counts == 0))
-                rank_p = jnp.cumsum(un_p.astype(jnp.int32)) - un_p
-                n_up = jnp.sum(un_p.astype(jnp.int32))
-                sidx_p = jnp.where(un_p, total + rank_p, C)  # C drops
-                need = need + n_up
-            if self.how == "full":
-                pt = join_k.build_lookup(pk, plive)
-                _, bmat = join_k.probe_unique(pt, bk, blive)
-                un_b = jnp.logical_and(
-                    b2.selection,
-                    jnp.logical_not(jnp.logical_and(blive, bmat)))
-                rank_b = jnp.cumsum(un_b.astype(jnp.int32)) - un_b
-                sidx_b = jnp.where(un_b, total + n_up + rank_b, C)
-                need = need + jnp.sum(un_b.astype(jnp.int32))
-
-            live = olive
-            if sidx_p is not None:
-                live = live.at[sidx_p].set(True, mode="drop")
-            if sidx_b is not None:
-                live = live.at[sidx_b].set(True, mode="drop")
-
-            cols = []
-            for f in out_schema.fields:
-                from_probe = probe_schema.has_field(f.name)
-                src = p2 if from_probe else b2
-                rows = prows if from_probe else brows
-                c = src.column(f.name)
-                vals = jnp.take(c.values, rows)
-                validity = (jnp.take(c.validity, rows)
-                            if c.validity is not None else None)
-                src_valid = (c.validity if c.validity is not None
-                             else True)
-                if from_probe:
-                    if sidx_p is not None:
-                        vals = vals.at[sidx_p].set(c.values, mode="drop")
-                        if validity is not None:
-                            validity = validity.at[sidx_p].set(
-                                src_valid, mode="drop")
-                    if sidx_b is not None:  # probe cols null on
-                        if validity is None:  # build-only rows
-                            validity = jnp.ones((C,), jnp.bool_)
-                        validity = validity.at[sidx_b].set(
-                            False, mode="drop")
-                else:
-                    if sidx_p is not None:  # build cols null on
-                        if validity is None:  # probe-only rows
-                            validity = jnp.ones((C,), jnp.bool_)
-                        validity = validity.at[sidx_p].set(
-                            False, mode="drop")
-                    if sidx_b is not None:
-                        vals = vals.at[sidx_b].set(c.values, mode="drop")
-                        validity = validity.at[sidx_b].set(
-                            src_valid, mode="drop")
-                cols.append(Column(vals, f.dtype, validity, c.dictionary))
-            out = ColumnBatch(out_schema, cols, live,
-                              jnp.sum(live).astype(jnp.int32))
-            return jax.tree.map(lambda x: x[None], out), need[None]
-
-        cache[key] = jax.jit(run)
-        return cache[key](stacked_b, stacked_p, remaps)
+        return governed(key, build, cap=MESH_NS_CAP,
+                        metrics=self.metrics())(stacked_b, stacked_p,
+                                                remaps)
 
     def execute_stacked(self, mesh) -> ColumnBatch:
         """Device-resident execution: both inputs laid out over the mesh
@@ -424,14 +467,14 @@ class MeshJoinExec(PhysicalPlan):
 
         out_cap = self.n_devices * p_cap  # post-shuffle probe rows/device
         if self.how == "full":  # + room for unmatched build rows
-            out_cap = round_capacity(out_cap + self.n_devices * b_cap)
+            out_cap = bucket_capacity(out_cap + self.n_devices * b_cap)
         while True:
             out_stacked, totals = self._spmd(sb, sp, mesh, remaps, out_cap,
                                              b_cap, p_cap)
             t = host_max(totals)  # multihost-safe replicated max
             if t <= out_cap:
                 return out_stacked
-            out_cap = round_capacity(t)  # duplicate-heavy keys: retry
+            out_cap = bucket_capacity(t)  # duplicate-heavy keys: retry
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
         if partition != 0:

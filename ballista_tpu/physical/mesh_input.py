@@ -34,8 +34,8 @@ from ..columnar import (
     ColumnBatch,
     Dictionary,
     empty_batch,
-    round_capacity,
 )
+from ..compile import bucket_capacity, governed
 from ..datatypes import Schema
 from ..parallel.mesh import shard_map
 
@@ -137,7 +137,12 @@ def _compact_impl(big: ColumnBatch, cap: int) -> ColumnBatch:
                        big.num_rows.astype(jnp.int32))
 
 
-_compact_to = partial(jax.jit, static_argnames=("cap",))(_compact_impl)
+def _compact_to(big: ColumnBatch, cap: int) -> ColumnBatch:
+    """Governed jit of :func:`_compact_impl` at a static capacity."""
+    return governed(
+        ("mesh.compact_to", cap),
+        lambda: partial(_compact_impl, cap=cap),
+    )(big)
 
 
 # ---------------------------------------------------------------------------
@@ -227,7 +232,7 @@ def assemble_over_mesh(producer, schema: Schema, mesh
             bigs = [empty_batch(schema)]
         big = bigs[0] if len(bigs) == 1 else concat_batches(schema, bigs)
         n = int(big.num_rows)  # scalar sync only
-        cap = round_capacity(max(-(-n // n_dev), 1))
+        cap = bucket_capacity(max(-(-n // n_dev), 1))
         packed = _compact_to(big, cap=n_dev * cap)
         slot_batches = [
             _window_slot(packed, d * cap, cap,
@@ -240,13 +245,13 @@ def assemble_over_mesh(producer, schema: Schema, mesh
         # capacity must agree across processes: replicated global max
         local_counts = [slot_bigs[i].num_rows for i in local_slots]
         gcounts = multihost.stack_local_to_global(local_counts, mesh)
-        cap = round_capacity(max(multihost.host_max(gcounts), 1))
+        cap = bucket_capacity(max(multihost.host_max(gcounts), 1))
     else:
         # ONE batched fetch for all slot counts: sequential int() reads
         # would pay a device->host round-trip per device
         counts = [int(c) for c in jax.device_get(
             [slot_bigs[i].num_rows for i in local_slots])]
-        cap = round_capacity(max(max(counts), 1))
+        cap = bucket_capacity(max(max(counts), 1))
     slot_batches = [_compact_to(slot_bigs[i], cap=cap)
                     for i in local_slots]
     return multihost.stack_local_to_global(slot_batches, mesh), cap
@@ -274,12 +279,11 @@ def _window_slot(packed: ColumnBatch, start: int, cap: int,
 # ---------------------------------------------------------------------------
 
 
-from collections import OrderedDict
-
-# bounded: treedef keys hold identity-hashed per-query Dictionary objects,
-# so an unbounded cache would pin executables + dictionaries forever
-_STACKED_COMPACT_JITS: OrderedDict = OrderedDict()
-_STACKED_COMPACT_CAP = 32
+# mesh.* governed namespaces are LRU-bounded (compile.MESH_NS_CAP):
+# their keys hold meshes and pytree structures whose aux-data pins
+# identity-hashed per-query Dictionary objects — an unbounded cache
+# would pin executables + dictionaries forever
+from ..compile import MESH_NS_CAP as _MESH_NS_CAP
 
 
 def _maybe_compact_stacked(stacked: ColumnBatch, mesh,
@@ -289,17 +293,12 @@ def _maybe_compact_stacked(stacked: ColumnBatch, mesh,
     from ..parallel.multihost import host_max
 
     cap = int(stacked.selection.shape[1])
-    new_cap = max(round_capacity(host_max(stacked.num_rows)), 8)
+    new_cap = max(bucket_capacity(host_max(stacked.num_rows)), 8)
     if new_cap * shrink_factor > cap:
         return stacked
     axis = mesh.axis_names[0]
-    key = (mesh, cap, new_cap, jax.tree.structure(stacked))
-    if key in _STACKED_COMPACT_JITS:
-        _STACKED_COMPACT_JITS.move_to_end(key)
-    else:
-        while len(_STACKED_COMPACT_JITS) >= _STACKED_COMPACT_CAP:
-            _STACKED_COMPACT_JITS.popitem(last=False)
 
+    def build():
         @partial(shard_map, mesh=mesh, in_specs=(P(axis),),
                  out_specs=P(axis), check_vma=False)
         def run(st):
@@ -307,42 +306,49 @@ def _maybe_compact_stacked(stacked: ColumnBatch, mesh,
             out = _compact_impl(b, new_cap)
             return jax.tree.map(lambda x: x[None], out)
 
-        _STACKED_COMPACT_JITS[key] = jax.jit(run)
-    return _STACKED_COMPACT_JITS[key](stacked)
+        return run
+
+    key = ("mesh.compact", mesh, cap, new_cap, jax.tree.structure(stacked))
+    return governed(key, build, cap=_MESH_NS_CAP)(stacked)
 
 
 def _chain_pipeline(plan, chain, inner: ColumnBatch, mesh) -> ColumnBatch:
     """Apply a fused PipelineOp chain per device over a stacked input."""
     axis = mesh.axis_names[0]
-    cache = plan.__dict__.setdefault("_stacked_jit", {})
-    key = (mesh, int(inner.selection.shape[1]))
-    if key not in cache:
+
+    def build():
+        # twins: don't pin the producer subtree in the governed entry
+        twins = [op.trace_twin() for op in chain]
 
         @partial(shard_map, mesh=mesh, in_specs=(P(axis),),
                  out_specs=P(axis), check_vma=False)
         def run(st):
             b = jax.tree.map(lambda x: x[0], st)
-            for op in chain:
+            for op in twins:
                 b = op.device_transform(b)
             return jax.tree.map(lambda x: x[None], b)
 
-        cache[key] = jax.jit(run)
-    return cache[key](inner)
+        return run
+
+    key = ("mesh.chain", tuple(op.compile_signature() for op in chain),
+           mesh, int(inner.selection.shape[1]))
+    return governed(key, build, cap=_MESH_NS_CAP,
+                    metrics=plan.metrics())(inner)
 
 
 def _chain_partial_agg(agg, inner: ColumnBatch, mesh) -> ColumnBatch:
     """Run a partial HashAggregate per device over a stacked input
     (adaptive group capacity with whole-SPMD retry, like the final
     aggregate inside MeshAggExec)."""
+    from ..columnar import round_capacity
+
     axis = mesh.axis_names[0]
     in_cap = int(inner.selection.shape[1])
-    cache = agg.__dict__.setdefault("_stacked_jit", {})
     cap = agg.group_capacity
     while True:
-        key = (mesh, in_cap, cap)
-        if key not in cache:
-            fn = agg._get_grouped_fn(cap, in_cap)
+        fn = agg._get_grouped_fn(cap, in_cap)
 
+        def build():
             @partial(shard_map, mesh=mesh, in_specs=(P(axis),),
                      out_specs=(P(axis), P(axis)), check_vma=False)
             def run(st):
@@ -350,8 +356,12 @@ def _chain_partial_agg(agg, inner: ColumnBatch, mesh) -> ColumnBatch:
                 out, ng = fn(b)
                 return jax.tree.map(lambda x: x[None], out), ng[None]
 
-            cache[key] = jax.jit(run)
-        out_stacked, ngs = cache[key](inner)
+            return run
+
+        key = ("mesh.partial_agg", agg.compile_signature(), mesh, in_cap,
+               cap)
+        out_stacked, ngs = governed(key, build, cap=_MESH_NS_CAP,
+                                    metrics=agg.metrics())(inner)
         from ..parallel.multihost import host_max
 
         ng = host_max(ngs)  # multihost-safe replicated max

@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..columnar import Column, ColumnBatch, round_capacity
+from ..columnar import Column, ColumnBatch
+from ..compile import bucket_capacity, fingerprint
 from ..datatypes import Schema
 from ..errors import ExecutionError, NotImplementedError_
 from .. import expr as ex
@@ -92,6 +93,9 @@ class FilterExec(PipelineOp):
         self.child = child
         self._ev = Evaluator(child.output_schema())
 
+    def _signature_parts(self) -> tuple:
+        return (fingerprint(self.predicate), self.child.output_schema())
+
     def output_schema(self) -> Schema:
         return self.child.output_schema()
 
@@ -114,6 +118,9 @@ class ProjectionExec(PipelineOp):
         self._in_schema = child.output_schema()
         self._ev = Evaluator(self._in_schema)
         self._schema = Schema([e.to_field(self._in_schema) for e in self.exprs])
+
+    def _signature_parts(self) -> tuple:
+        return (fingerprint(self.exprs), self._in_schema)
 
     def output_schema(self) -> Schema:
         return self._schema
@@ -194,7 +201,9 @@ class SortExec(PhysicalPlan):
         self.sort_exprs = list(sort_exprs)
         self.child = child
         self._ev = Evaluator(child.output_schema())
-        self._jit_sort = None
+
+    def _signature_parts(self) -> tuple:
+        return (fingerprint(self.sort_exprs), self.child.output_schema())
 
     def output_schema(self) -> Schema:
         return self.child.output_schema()
@@ -213,20 +222,23 @@ class SortExec(PhysicalPlan):
         if not batches:
             return
         batch = concat_batches(self.output_schema(), batches)
-        if self._jit_sort is None:
+
+        def build():
+            tw = self.trace_twin()  # don't pin the child subtree
 
             def do_sort(b: ColumnBatch) -> ColumnBatch:
                 keys = []
-                for se in self.sort_exprs:
-                    r = self._ev.evaluate(se.expr, b)
+                for se in tw.sort_exprs:
+                    r = tw._ev.evaluate(se.expr, b)
                     v = jnp.broadcast_to(r.values, (b.capacity,))
                     keys.append((v, se.ascending))
                 perm = sort_permutation(keys, b.selection)
                 live_sorted = jnp.take(b.selection, perm)
                 return take_batch(b, perm, live_sorted)
 
-            self._jit_sort = jax.jit(do_sort)
-        yield self._jit_sort(batch)
+            return do_sort
+
+        yield self.governed_jit(("sort.run",), build)(batch)
 
     def display(self) -> str:
         return f"SortExec: {', '.join(e.name() for e in self.sort_exprs)}"
@@ -239,7 +251,9 @@ class LimitExec(PhysicalPlan):
     def __init__(self, n: int, child: PhysicalPlan):
         self.n = n
         self.child = child
-        self._jit_limit = None
+
+    def _signature_parts(self) -> tuple:
+        return ()  # take_first is operator-independent (n is traced)
 
     def output_schema(self) -> Schema:
         return self.child.output_schema()
@@ -252,18 +266,20 @@ class LimitExec(PhysicalPlan):
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
         remaining = self.n
-        if self._jit_limit is None:
 
+        def build():
             def take_first(b: ColumnBatch, k) -> ColumnBatch:
                 rank = jnp.cumsum(b.selection.astype(jnp.int32)) - 1
                 sel = jnp.logical_and(b.selection, rank < k)
                 return b.with_selection(sel)
 
-            self._jit_limit = jax.jit(take_first)
+            return take_first
+
+        take = self.governed_jit(("limit.take",), build)
         for batch in self.child.execute(partition):
             if remaining <= 0:
                 break
-            out = self._jit_limit(batch, jnp.int32(remaining))
+            out = take(batch, jnp.int32(remaining))
             remaining -= out.num_rows_host()
             yield out
 
@@ -287,7 +303,15 @@ class RepartitionExec(PhysicalPlan):
         self.hash_exprs = hash_exprs
         self._ev = Evaluator(child.output_schema())
         self._cache: Optional[List[ColumnBatch]] = None
-        self._jit_mask = None
+
+    def _signature_parts(self) -> tuple:
+        return (self.num_partitions, fingerprint(self.hash_exprs),
+                self.child.output_schema())
+
+    def _detach(self) -> None:
+        super()._detach()
+        self._cache = None
+        self._parts = None  # materialized batches must not be pinned
 
     def output_schema(self) -> Schema:
         return self.child.output_schema()
@@ -322,11 +346,12 @@ class RepartitionExec(PhysicalPlan):
         ONCE (not once per output partition): partition p is then a
         contiguous slice of the permutation. [(batch, perm, counts)]"""
         if getattr(self, "_parts", None) is None:
-            if self._jit_mask is None:
-                n_out = self.num_partitions
+            def build():
+                tw = self.trace_twin()  # don't pin materialized batches
+                n_out = tw.num_partitions
 
                 def sort_by_pid(b: ColumnBatch, offset):
-                    pids = self.partition_ids(b, offset)
+                    pids = tw.partition_ids(b, offset)
                     d = jnp.where(b.selection, pids, n_out)  # dead last
                     idx = jnp.arange(b.capacity, dtype=jnp.int32)
                     _, perm = jax.lax.sort((d, idx), num_keys=1,
@@ -334,11 +359,13 @@ class RepartitionExec(PhysicalPlan):
                     counts = jnp.bincount(d, length=n_out + 1)[:n_out]
                     return perm, counts
 
-                self._jit_mask = jax.jit(sort_by_pid)
+                return sort_by_pid
+
+            mask_fn = self.governed_jit(("repart.sort_by_pid",), build)
             parts = []
             offset = 0
             for batch in self._materialize():
-                perm, counts = self._jit_mask(batch, jnp.int32(offset))
+                perm, counts = mask_fn(batch, jnp.int32(offset))
                 parts.append((batch, perm, np.asarray(counts)))
                 offset += batch.num_rows_host()
             self._parts = parts
@@ -379,35 +406,36 @@ class RepartitionExec(PhysicalPlan):
 
     def _execute_fragments(self, partition: int, frag_lo: int,
                            frag_hi) -> Iterator[ColumnBatch]:
-        self._jit_take = getattr(self, "_jit_take", {})
         pieces = []
         for batch, perm, counts in self._materialize_parts()[
                 frag_lo:frag_hi]:
             n = int(counts[partition])
             start = int(counts[:partition].sum())
             # never exceed the source capacity: a longer slice would
-            # silently clamp
-            cap = min(round_capacity(n), batch.capacity)
+            # silently clamp. Bucketed, so unevenly-filled output
+            # partitions land on the canonical ladder
+            cap = min(bucket_capacity(n), batch.capacity)
             idx = perm[start:start + cap]
             if int(idx.shape[0]) < cap:  # tail partition: pad the gather
                 idx = jnp.pad(idx, (0, cap - int(idx.shape[0])))
-            key = (batch.capacity, cap)
-            if key not in self._jit_take:
 
-                def take_front(b, idx, n, _cap=cap):
+            def build(_cap=cap):
+                def take_front(b, idx, n):
                     live = jnp.arange(_cap, dtype=jnp.int32) < n
                     return take_batch(b, idx, live)
 
-                self._jit_take[key] = jax.jit(take_front)
-            pieces.append(self._jit_take[key](batch, idx, jnp.int32(n)))
+                return take_front
+
+            take = self.governed_jit(("repart.take", cap), build)
+            pieces.append(take(batch, idx, jnp.int32(n)))
         if len(pieces) == 1:
             yield pieces[0]
         elif pieces:
             out = concat_batches(self.output_schema(), pieces)
-            # concat of power-of-two pieces isn't itself a power of two
+            # concat of ladder-sized pieces isn't itself a ladder rung
             # (128+64=192); pad up so downstream per-capacity jit caches
             # reuse one compiled program across output partitions
-            target = round_capacity(out.capacity)
+            target = bucket_capacity(out.capacity)
             if target != out.capacity:
                 out = pad_batch(out, target)
             yield out

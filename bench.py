@@ -340,6 +340,9 @@ def _run_bench(args) -> None:
     force_cpu = args.cpu
     if force_cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
+    # overlap scan-chain XLA compiles with parse/H2D on the cold path
+    # (compile/prewarm.py; an explicit user setting wins)
+    os.environ.setdefault("BALLISTA_PREWARM", "1")
     import jax
 
     if force_cpu:
@@ -384,8 +387,20 @@ def _run_bench(args) -> None:
         "scale": args.scale, "partial": "init",
     }
 
+    from ballista_tpu.compile import compile_stats
+
+    def record_compiles():
+        # cold-path trajectory: process-wide XLA compile work and how
+        # much of it the persistent disk cache absorbed (ISSUE 3 asks
+        # for these in every bench line from this PR on)
+        st = compile_stats()
+        result["compile_count"] = int(st["backend_compiles"])
+        result["compile_seconds"] = round(float(st["compile_seconds"]), 3)
+        result["persistent_cache_hit"] = int(st["persistent_cache_hits"])
+
     def snapshot(phase: str):
         result["partial"] = phase
+        record_compiles()
         print(json.dumps(result), flush=True)
 
     # -- cold: re-scan per run (what the reference benchmark does) ----------
@@ -481,6 +496,7 @@ def _run_bench(args) -> None:
         finally:
             os.environ.pop("BALLISTA_PALLAS", None)
     result.pop("partial", None)  # complete: drop the phase marker
+    record_compiles()
     # flush so the parent's watchdog can salvage the line even if this
     # process subsequently wedges in teardown and gets killed
     print(json.dumps(result), flush=True)

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Guard against raw ``jax.jit`` call sites regrowing outside the
+compile governor.
+
+PR 3 folded ~10 scattered ad-hoc jit caches (per-instance
+``self._jit_cache`` dicts, module-level ``*_JITS`` maps) into
+``ballista_tpu/compile/`` so compilation is a managed, observable
+resource: adaptive re-plans reuse traces, compile counts/seconds flow
+into operator metrics, and shape bucketing bounds the signature count.
+A stray ``jax.jit(`` anywhere else silently re-creates the
+uncounted-per-instance-cache problem — this lint (run from tier-1,
+tests/test_compile_governor.py) fails the build instead.
+
+Scans ``ballista_tpu/**/*.py`` for ``jax.jit`` / ``pjit`` uses. The
+allowlist names the legitimate remainder (the governor itself).
+
+Usage: python dev/check_jit_sites.py   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Tuple
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PKG = os.path.join(HERE, "..", "ballista_tpu")
+
+# repo-relative files allowed to call jax.jit directly
+ALLOWLIST = {
+    "ballista_tpu/compile/governor.py",  # THE jit site: the governor
+}
+
+# individual call sites elsewhere opt out with a trailing
+# ``# jit-ok: <reason>`` comment on the offending line — file-level
+# allowlisting would silently exempt future sites in the same module
+MARKER = "jit-ok:"
+
+# jax.jit(...), jax.pjit(...), bare pjit( after a from-import
+_PAT = re.compile(r"\bjax\s*\.\s*(?:jit|pjit)\s*\(|\bpjit\s*\(")
+_COMMENT = re.compile(r"(^|\s)#.*$")
+
+
+def scan() -> List[Tuple[str, int, str]]:
+    hits: List[Tuple[str, int, str]] = []
+    for root, _dirs, files in os.walk(os.path.abspath(PKG)):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(
+                path, os.path.abspath(os.path.join(HERE, ".."))
+            ).replace(os.sep, "/")
+            if rel in ALLOWLIST:
+                continue
+            in_doc = False
+            for i, line in enumerate(open(path, encoding="utf-8"), 1):
+                # crude but sufficient: strip comments; skip docstring
+                # bodies (module docs MENTION jax.jit legitimately)
+                if line.count('"""') % 2 == 1:
+                    in_doc = not in_doc
+                    continue
+                if in_doc or MARKER in line:
+                    continue
+                code = _COMMENT.sub("", line)
+                if _PAT.search(code):
+                    hits.append((rel, i, line.rstrip()))
+    return hits
+
+
+def main() -> int:
+    hits = scan()
+    if hits:
+        for rel, i, line in hits:
+            print(f"JIT-SITE: {rel}:{i}: {line.strip()}", file=sys.stderr)
+        print(
+            f"{len(hits)} raw jax.jit call site(s) outside "
+            "ballista_tpu/compile/ — route them through "
+            "ballista_tpu.compile.governed() (or extend the allowlist "
+            "with a justification)",
+            file=sys.stderr,
+        )
+        return 1
+    print("no raw jax.jit sites outside ballista_tpu/compile/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

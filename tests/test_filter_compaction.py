@@ -21,13 +21,17 @@ def _scan(n, capacity=None):
 
 
 def test_selective_filter_compacts_output():
+    from ballista_tpu.compile import bucket_capacity
+
     f = FilterExec(col("k") < lit(10), _scan(4096))
     batches = list(f.execute(0))
     assert len(batches) == 1
     b = batches[0]
     assert int(b.num_rows) == 10
-    # capacity shrank to the survivors' power-of-two, not the scan's 4096
-    assert b.capacity < 4096 // 4
+    # capacity shrank to the survivors' canonical ladder rung (the
+    # bucket floor by default), not the scan's 4096
+    assert b.capacity == bucket_capacity(10)
+    assert b.capacity < 4096
     assert sorted(np.asarray(b.column("k").values)[:10].tolist()) == \
         list(range(10))
 
