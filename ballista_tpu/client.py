@@ -253,8 +253,22 @@ class BallistaContext:
         from .compile import maybe_prewarm
 
         maybe_prewarm(phys)
-        phys = self._apply_adaptive(phys)
-        out = pd.DataFrame(collect_physical(phys))
+        # Parallel ingest (ballista_tpu/ingest): start parse+H2D for
+        # every leaf scan NOW, so independent tables overlap each other
+        # and the adaptive pass's eager repartition materialization
+        # below consumes already-running streams. Scan INSTANCES
+        # survive the adaptive rewrite (with_new_children keeps
+        # leaves), so primed handles are consumed by the re-planned
+        # tree; anything a rewrite or early exit leaves behind is
+        # cancelled, never leaked.
+        from .ingest import cancel_plan, prime_plan
+
+        prime_plan(phys)
+        try:
+            phys = self._apply_adaptive(phys)
+            out = pd.DataFrame(collect_physical(phys))
+        finally:
+            cancel_plan(phys)
         self._record_plan_metrics(phys)
         return out, phys
 

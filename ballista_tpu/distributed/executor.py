@@ -189,9 +189,20 @@ class Executor:
         ((hash_exprs|None, n_out)) the output is hash/round-robin split
         into one shuffle-q file per consumer partition."""
         from ..io import ipc
+        from ..ingest import cancel_plan, prime_plan
 
         t0 = time.time()
-        batches = list(plan.execute(pid.partition_id))
+        # parallel ingest: start this task's leaf-scan parse+H2D on the
+        # pool before pulling, so a plan with several scan leaves (e.g.
+        # a merged join stage) parses them concurrently; primed handles
+        # an aborted task leaves behind are cancelled, never leaked
+        prime_plan(plan, partitions=[pid.partition_id])
+        try:
+            batches = list(plan.execute(pid.partition_id))
+        finally:
+            # handles the plan never consumed (limit short-circuits,
+            # failures) must not leave producers parked on full queues
+            cancel_plan(plan)
         if shuffle is not None:
             stats = self._write_shuffled(pid, plan, batches, shuffle, t0)
             stats["task_metrics"] = self._harvest_metrics(

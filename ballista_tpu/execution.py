@@ -126,11 +126,16 @@ def plan_logical(plan: LogicalPlan, options=None) -> PhysicalPlan:
 
 
 def collect_physical(phys: PhysicalPlan) -> Dict[str, np.ndarray]:
-    """Execute all partitions and concatenate live rows on host."""
+    """Execute all partitions and concatenate live rows on host.
+    Partitions run concurrently on the ingest pool (batch order is
+    preserved — see ingest.iter_partitions); serial when the pipeline
+    is gated off."""
+    from .ingest import iter_partitions
+
     parts: List[Dict[str, np.ndarray]] = []
-    for p in range(phys.output_partitioning().num_partitions):
-        for batch in phys.execute(p):
-            parts.append(batch.to_pydict())
+    for batch in iter_partitions(
+            phys, range(phys.output_partitioning().num_partitions)):
+        parts.append(batch.to_pydict())
     if not parts:
         return {f.name: np.asarray([]) for f in phys.output_schema().fields}
     return concat_pydicts(parts)

@@ -7,13 +7,21 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..datatypes import Schema
+from ..ingest import KeyedLocks
 from ..logical import TableSource
 
 
 class CacheSource(TableSource):
+    """Thread-safe: parallel ingest (and self-joins) scan the same
+    (partition, projection) key concurrently, so materialization takes a
+    PER-KEY lock — exactly one inner scan runs, late arrivals wait for
+    it and serve from the cache (an unlocked dict would materialize the
+    inner scan once per racer and interleave the insert)."""
+
     def __init__(self, inner: TableSource):
         self.inner = inner
         self._cache: Dict[Tuple[int, Optional[Tuple[str, ...]]], list] = {}
+        self._key_locks = KeyedLocks()
 
     def table_schema(self) -> Schema:
         return self.inner.table_schema()
@@ -27,11 +35,25 @@ class CacheSource(TableSource):
     def estimated_rows(self):
         return self.inner.estimated_rows()
 
+    def is_materialized(self, partition: int,
+                        projection: Optional[Sequence[str]] = None) -> bool:
+        """True when this (partition, projection) is already served from
+        memory — the ingest pipeline then skips its prefetch queue (no
+        parse/H2D left to overlap; keeps the warm path overhead-free)."""
+        key = (partition, tuple(projection) if projection is not None else None)
+        return key in self._cache
+
     def scan(self, partition: int, projection: Optional[Sequence[str]] = None):
         key = (partition, tuple(projection) if projection is not None else None)
-        if key not in self._cache:
-            self._cache[key] = list(self.inner.scan(partition, projection))
+        if key not in self._cache:  # fast path: no lock once populated
+            with self._key_locks.get(key):
+                if key not in self._cache:
+                    self._cache[key] = list(self.inner.scan(partition,
+                                                            projection))
         yield from self._cache[key]
 
     def invalidate(self):
+        # locks are NOT dropped: a materialization mid-flight still
+        # holds one, and dropping it would let a post-invalidate scan
+        # run a second concurrent inner scan against it
         self._cache.clear()

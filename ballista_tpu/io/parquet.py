@@ -13,6 +13,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..ingest.phases import phase
+
 from ..columnar import ColumnBatch, Dictionary, DEFAULT_BATCH_CAPACITY
 from ..compile import bucket_capacity
 from ..datatypes import (
@@ -84,6 +86,14 @@ class ParquetSource(TableSource):
             schema = Schema(fields)
         self._schema = schema
         self._dicts: Dict[str, Dictionary] = {}
+        # concurrent partition scans (parallel ingest) share one
+        # dictionary instance per column; per-COLUMN locks so builds of
+        # distinct columns overlap on the ingest pool (each build reads
+        # every file — serializing them would re-serialize the cold
+        # path this subsystem pipelines)
+        from ..ingest import KeyedLocks
+
+        self._dict_locks = KeyedLocks()
 
     def table_schema(self) -> Schema:
         return self._schema
@@ -107,70 +117,122 @@ class ParquetSource(TableSource):
     def _dictionary_for(self, colname: str) -> Dictionary:
         import pyarrow.parquet as pq
 
-        if colname in self._dicts:
+        if colname in self._dicts:  # fast path once built
             return self._dicts[colname]
-        uniq: Optional[np.ndarray] = None
-        for f in self._files:
-            t = pq.read_table(f, columns=[colname])
-            vals = np.asarray(t.column(0).to_pylist(), dtype=object)
-            u = np.unique(vals)
-            uniq = u if uniq is None else np.unique(np.concatenate([uniq, u]))
-        d = Dictionary(uniq if uniq is not None else [])
-        self._dicts[colname] = d
-        return d
+        with self._dict_locks.get(colname):
+            if colname in self._dicts:
+                return self._dicts[colname]
+            with phase("parse"):
+                uniq: Optional[np.ndarray] = None
+                for f in self._files:
+                    t = pq.read_table(f, columns=[colname])
+                    # NULL strings follow the text-path convention: ""
+                    # is the stored value, validity rides separately
+                    # (and None would break object-array sorting)
+                    vals = np.asarray(
+                        ["" if v is None else v
+                         for v in t.column(0).to_pylist()], dtype=object)
+                    u = np.unique(vals)
+                    uniq = (u if uniq is None
+                            else np.unique(np.concatenate([uniq, u])))
+                d = Dictionary(uniq if uniq is not None else [])
+                self._dicts[colname] = d
+                return d
 
     def scan(self, partition: int, projection: Optional[Sequence[str]] = None):
         import pyarrow.parquet as pq
 
         names = list(projection) if projection is not None else list(self._schema.names())
         sub_schema = self._schema.project(names)
-        table = pq.read_table(self._files[partition], columns=names)
-        n = table.num_rows
-        arrays: Dict[str, np.ndarray] = {}
-        dicts: Dict[str, Dictionary] = {}
-        for name in names:
-            field = self._schema.field(name)
-            colarr = table.column(name)
-            if field.dtype.kind == "utf8":
-                d = self._dictionary_for(name)
-                vals = np.asarray(colarr.to_pylist(), dtype=object)
-                codes = np.searchsorted(d.values.astype(str), vals.astype(str))
-                arrays[name] = codes.astype(np.int32)
-                dicts[name] = d
-            elif field.dtype.kind == "decimal":
-                from ..columnar import decimal_to_scaled
+        with phase("parse", path=self._files[partition]):
+            table = pq.read_table(self._files[partition], columns=names)
+            n = table.num_rows
+            arrays: Dict[str, np.ndarray] = {}
+            dicts: Dict[str, Dictionary] = {}
+            valids: Dict[str, np.ndarray] = {}
+            for name in names:
+                field = self._schema.field(name)
+                colarr = table.column(name).combine_chunks()
+                # NULLs: non-string columns surface validity=False (same
+                # convention as the text scanners — the physical value is
+                # a harmless fill, the mask is the truth); utf8 NULLs
+                # store "" (a value), matching io/text.py's fillna("")
+                null_mask = None
+                if colarr.null_count:
+                    null_mask = np.asarray(colarr.is_null())
+                    if field.dtype.kind != "utf8":
+                        valids[name] = ~null_mask
+                if field.dtype.kind == "utf8":
+                    d = self._dictionary_for(name)
+                    vals = np.asarray(
+                        ["" if v is None else v for v in colarr.to_pylist()],
+                        dtype=object)
+                    codes = np.searchsorted(d.values.astype(str),
+                                            vals.astype(str))
+                    arrays[name] = codes.astype(np.int32)
+                    dicts[name] = d
+                elif field.dtype.kind == "decimal":
+                    from ..columnar import decimal_to_scaled
 
-                vals = colarr.cast("float64").to_numpy(zero_copy_only=False)
-                arrays[name] = decimal_to_scaled(vals, field.dtype.scale)
-            elif field.dtype.kind == "date32":
-                import pyarrow as pa
+                    vals = colarr.cast("float64").to_numpy(
+                        zero_copy_only=False)
+                    if null_mask is not None:  # NaN would scale to garbage
+                        vals = np.where(null_mask, 0.0, vals)
+                    arrays[name] = decimal_to_scaled(vals, field.dtype.scale)
+                elif field.dtype.kind == "date32":
+                    import pyarrow as pa
 
-                # files may store dates as date32 OR timestamps (pandas
-                # writers); normalize through date32 -> days-since-epoch
-                arr = colarr
-                if not pa.types.is_date32(arr.type):
-                    arr = arr.cast(pa.date32())
-                arrays[name] = arr.cast(pa.int32()).to_numpy(
-                    zero_copy_only=False
-                )
-            elif field.dtype.kind == "timestamp_ns":
-                import pyarrow as pa
+                    # files may store dates as date32 OR timestamps
+                    # (pandas writers); normalize through date32 ->
+                    # days-since-epoch. NULLs fill at the ARROW level:
+                    # to_numpy on a nullable array detours through
+                    # float64, which the integer paths must never do
+                    arr = colarr
+                    if not pa.types.is_date32(arr.type):
+                        arr = arr.cast(pa.date32())
+                    arr = arr.cast(pa.int32())
+                    if null_mask is not None:
+                        arr = arr.fill_null(0)
+                    arrays[name] = arr.to_numpy(
+                        zero_copy_only=False).astype(np.int32)
+                elif field.dtype.kind == "timestamp_ns":
+                    import pyarrow as pa
 
-                arr = colarr.cast(pa.timestamp("ns"))
-                arrays[name] = arr.cast(pa.int64()).to_numpy(
-                    zero_copy_only=False
-                )
-            else:
-                arrays[name] = colarr.to_numpy(zero_copy_only=False).astype(
-                    field.dtype.device_dtype()
-                )
+                    arr = colarr.cast(pa.timestamp("ns")).cast(pa.int64())
+                    if null_mask is not None:  # arrow-level fill: exact
+                        arr = arr.fill_null(0)
+                    arrays[name] = arr.to_numpy(
+                        zero_copy_only=False).astype(np.int64)
+                else:
+                    # integers with NULLs: fill on the arrow array so the
+                    # conversion stays integral end-to-end (a float64
+                    # detour would silently round int64 above 2^53 —
+                    # same invariant the text path pins with
+                    # test_big_int64_survives_null_column)
+                    arr = colarr
+                    if null_mask is not None:
+                        import pyarrow as pa
+
+                        fill = (False if pa.types.is_boolean(arr.type)
+                                else 0)
+                        arr = arr.fill_null(fill)
+                    arrays[name] = arr.to_numpy(
+                        zero_copy_only=False).astype(
+                            field.dtype.device_dtype())
         cap = min(self._capacity, bucket_capacity(max(n, 1)))
         start = 0
         emitted = False
         while start < n or not emitted:
             end = min(start + cap, n)
             chunk = {k: v[start:end] for k, v in arrays.items()}
-            yield ColumnBatch.from_numpy(sub_schema, chunk, dicts, capacity=cap)
+            vchunk = (
+                {k: v[start:end] for k, v in valids.items()}
+                if valids else None
+            )
+            with phase("h2d", rows=end - start):
+                batch = ColumnBatch.from_numpy(sub_schema, chunk, dicts,
+                                               capacity=cap, validity=vchunk)
+            yield batch
             emitted = True
             start = end
             if start >= n:

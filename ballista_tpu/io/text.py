@@ -18,6 +18,7 @@ every batch of the table.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -26,6 +27,7 @@ from ..columnar import ColumnBatch, Dictionary, DEFAULT_BATCH_CAPACITY
 from ..compile import bucket_capacity
 from ..datatypes import Schema
 from ..errors import IoError
+from ..ingest.phases import phase
 from ..logical import TableSource
 
 # Files larger than this stream through the native scanner in byte-range
@@ -72,6 +74,12 @@ class DelimitedSource(TableSource):
         self._capacity = batch_capacity
         self._files = _list_files(path)
         self._dicts: Dict[str, Dictionary] = {}
+        # parallel ingest runs partitions of one table (and self-joined
+        # re-scans) concurrently: dictionary builds must publish exactly
+        # one instance per column (codes stay comparable across batches
+        # without union remaps). RLock: _dictionary_for may call
+        # _build_native_dicts.
+        self._dict_lock = threading.RLock()
 
     # -- TableSource --------------------------------------------------------
 
@@ -143,6 +151,12 @@ class DelimitedSource(TableSource):
         kept; per-range codes are discarded."""
         from . import native
 
+        with self._dict_lock:
+            self._build_native_dicts_locked(colnames)
+
+    def _build_native_dicts_locked(self, colnames: List[str]) -> None:
+        from . import native
+
         need = [n for n in colnames if n not in self._dicts]
         if not need:
             return
@@ -171,25 +185,30 @@ class DelimitedSource(TableSource):
             self._dicts[n] = Dictionary(uniq[n] if uniq[n] is not None else [])
 
     def _dictionary_for(self, colname: str) -> Dictionary:
-        """Global sorted dictionary over all partitions (built once)."""
-        if colname in self._dicts:
-            return self._dicts[colname]
-        if self._use_native():
-            self._build_native_dicts([colname])
-            return self._dicts[colname]
-        uniq: Optional[np.ndarray] = None
-        for f in self._files:
-            idx = self._schema.index_of(colname)
-            df = self._read_pandas(f, self._column_names(), [idx])
-            # empty fields: "" is a utf8 VALUE (native-scanner
-            # convention), not NULL
-            u = np.unique(
-                df[colname].fillna("").astype(str).to_numpy(dtype=object)
-            )
-            uniq = u if uniq is None else np.unique(np.concatenate([uniq, u]))
-        d = Dictionary(uniq if uniq is not None else [])
-        self._dicts[colname] = d
-        return d
+        """Global sorted dictionary over all partitions (built once;
+        concurrent scans serialize on the build and share the result)."""
+        with self._dict_lock:
+            if colname in self._dicts:
+                return self._dicts[colname]
+            with phase("parse"):
+                if self._use_native():
+                    self._build_native_dicts_locked([colname])
+                    return self._dicts[colname]
+                uniq: Optional[np.ndarray] = None
+                for f in self._files:
+                    idx = self._schema.index_of(colname)
+                    df = self._read_pandas(f, self._column_names(), [idx])
+                    # empty fields: "" is a utf8 VALUE (native-scanner
+                    # convention), not NULL
+                    u = np.unique(
+                        df[colname].fillna("").astype(str)
+                        .to_numpy(dtype=object)
+                    )
+                    uniq = (u if uniq is None
+                            else np.unique(np.concatenate([uniq, u])))
+                d = Dictionary(uniq if uniq is not None else [])
+                self._dicts[colname] = d
+                return d
 
     def _use_native(self) -> bool:
         # the native scanner does no quote handling; use it only for the
@@ -212,9 +231,11 @@ class DelimitedSource(TableSource):
                 yield from self._scan_native_streaming(
                     partition, names, sub_schema)
                 return
-            n, arrays, dicts, valids = self._scan_native(partition, names)
+            with phase("parse", path=self._files[partition]):
+                n, arrays, dicts, valids = self._scan_native(partition, names)
         else:
-            n, arrays, dicts, valids = self._scan_pandas(partition, names)
+            with phase("parse", path=self._files[partition]):
+                n, arrays, dicts, valids = self._scan_pandas(partition, names)
         # chunk into fixed-capacity batches
         yield from self._emit_batches(sub_schema, n, arrays, dicts, valids)
 
@@ -231,7 +252,8 @@ class DelimitedSource(TableSource):
         size = os.path.getsize(path)
         utf8_names = [n for n in names
                       if self._schema.field(n).dtype.kind == "utf8"]
-        self._build_native_dicts(utf8_names)
+        with phase("parse", path=path, prepass="dicts"):
+            self._build_native_dicts(utf8_names)
         # hoist the fixed-width dictionary copies out of the chunk loop:
         # re-materializing a big dictionary per 256MB range would churn
         # exactly the memory this path exists to bound
@@ -240,20 +262,21 @@ class DelimitedSource(TableSource):
         off = 0
         emitted = False
         while off < size:
-            n, arrays, fdicts, valids = native.scan_file(
-                path, self._schema, list(names), self._delim, self._header,
-                offset=off, max_bytes=STREAM_CHUNK_BYTES,
-            )
-            off += STREAM_CHUNK_BYTES
-            if n == 0:
-                continue
-            dicts: Dict[str, Dictionary] = {}
-            for name in utf8_names:
-                d = self._dicts[name]
-                remap = np.searchsorted(dict_keys[name],
-                                        fdicts[name].astype(str))
-                arrays[name] = remap[arrays[name]].astype(np.int32)
-                dicts[name] = d
+            with phase("parse", path=path, offset=off):
+                n, arrays, fdicts, valids = native.scan_file(
+                    path, self._schema, list(names), self._delim,
+                    self._header, offset=off, max_bytes=STREAM_CHUNK_BYTES,
+                )
+                off += STREAM_CHUNK_BYTES
+                if n == 0:
+                    continue
+                dicts: Dict[str, Dictionary] = {}
+                for name in utf8_names:
+                    d = self._dicts[name]
+                    remap = np.searchsorted(dict_keys[name],
+                                            fdicts[name].astype(str))
+                    arrays[name] = remap[arrays[name]].astype(np.int32)
+                    dicts[name] = d
             yield from self._emit_batches(sub_schema, n, arrays, dicts,
                                           valids, force_emit=False)
             emitted = True
@@ -279,9 +302,10 @@ class DelimitedSource(TableSource):
                 continue
             fvals = fdicts[name]
             if len(self._files) == 1:
-                if name not in self._dicts:
-                    self._dicts[name] = Dictionary(fvals)
-                d = self._dicts[name]
+                with self._dict_lock:  # one adopted instance per column
+                    if name not in self._dicts:
+                        self._dicts[name] = Dictionary(fvals)
+                    d = self._dicts[name]
                 # same file scanned twice must yield the same dict; remap
                 # defensively if the cached dict came from elsewhere
                 if len(d) != len(fvals) or not np.array_equal(
@@ -356,8 +380,10 @@ class DelimitedSource(TableSource):
                 {k: v[start:end] for k, v in valids.items()}
                 if valids else None
             )
-            yield ColumnBatch.from_numpy(sub_schema, chunk, dicts,
-                                         capacity=cap, validity=vchunk)
+            with phase("h2d", rows=end - start):
+                batch = ColumnBatch.from_numpy(sub_schema, chunk, dicts,
+                                               capacity=cap, validity=vchunk)
+            yield batch
             emitted = True
             start = end
             if start >= n:

@@ -32,10 +32,15 @@ _state: dict = {"configured": False, "fh": None}
 
 
 def _configure_locked() -> None:
-    _state["configured"] = True
+    # "configured" must be published LAST: _fh() double-checks it
+    # WITHOUT the lock, so flipping it before the file handle exists
+    # opens a window where a concurrent thread (ingest pipeline
+    # producers trace from pool workers) reads fh=None and silently
+    # drops its event
     if os.environ.get("BALLISTA_TRACE", "").lower() not in ("1", "on",
                                                             "true"):
         _state["fh"] = None
+        _state["configured"] = True
         return
     path = os.environ.get("BALLISTA_TRACE_FILE")
     if not path:
@@ -48,6 +53,7 @@ def _configure_locked() -> None:
         _state["path"] = path
     except OSError:
         _state["fh"] = None
+    _state["configured"] = True
 
 
 def _fh():

@@ -102,8 +102,11 @@ class HashAggregateExec(PhysicalPlan):
             if not isinstance(a, ex.AggregateExpr):
                 raise ExecutionError(f"not an aggregate expression: {name}")
         self._ranged_rejected = False
+        # None = unprobed; () = permanently ineligible; else ONE tuple
+        # (dict-length fingerprint, layout) — published atomically, so
+        # concurrent partition execution (ingest iter_partitions) can
+        # never pair one thread's layout with another's fingerprint
         self._mixed_cache = None
-        self._mixed_fingerprint = None
 
     # -- schemas ------------------------------------------------------------
 
@@ -322,14 +325,15 @@ class HashAggregateExec(PhysicalPlan):
         overflow its mixed-radix digit and collide groups. The cache is
         therefore keyed on the batch's dictionary lengths and re-probed
         when they change."""
-        if self._mixed_cache == ():  # dtype kinds never change: permanent
+        cached = self._mixed_cache  # one read: (fp, layout) or ()/None
+        if cached == ():  # dtype kinds never change: permanent
             return None
         fp = tuple(
             len(c.dictionary) if c.dictionary is not None else -1
             for c in batch.columns
         )
-        if self._mixed_cache is not None and self._mixed_fingerprint == fp:
-            return self._mixed_cache
+        if cached is not None and cached[0] == fp:
+            return cached[1]
         meta: List = []
 
         def probe(b):
@@ -354,8 +358,7 @@ class HashAggregateExec(PhysicalPlan):
             else:
                 self._mixed_cache = ()
                 return None
-        self._mixed_cache = layout
-        self._mixed_fingerprint = fp
+        self._mixed_cache = (fp, layout)  # atomic pair publication
         return layout
 
     def _mixed_stats(self, batch: ColumnBatch, layout):

@@ -105,19 +105,29 @@ class ExplainAnalyzeExec(PhysicalPlan):
         reset_plan_metrics(self.inner)
         t0 = _time.perf_counter()
         with force_metrics():
-            if self.adaptive_conf is not None and \
-                    self.adaptive_conf.enabled and not self._adapted:
-                # inside force_metrics: the rewrite materializes
-                # pipeline-breaker inputs, and those executions must be
-                # measured like the rest of the run
-                from ..adaptive.standalone import apply_adaptive_rules
+            # parallel ingest: ANALYZE measures the same pipelined
+            # execution a plain collect would run (scan instances
+            # survive the adaptive rewrite below)
+            from ..ingest import cancel_plan, prime_plan
 
-                self.inner = apply_adaptive_rules(self.inner,
-                                                  self.adaptive_conf)
-                self._adapted = True
-            for p in range(self.inner.output_partitioning().num_partitions):
-                for _ in self.inner.execute(p):
-                    pass  # drain: ANALYZE reports metrics, not rows
+            prime_plan(self.inner)
+            try:
+                if self.adaptive_conf is not None and \
+                        self.adaptive_conf.enabled and not self._adapted:
+                    # inside force_metrics: the rewrite materializes
+                    # pipeline-breaker inputs, and those executions must
+                    # be measured like the rest of the run
+                    from ..adaptive.standalone import apply_adaptive_rules
+
+                    self.inner = apply_adaptive_rules(self.inner,
+                                                      self.adaptive_conf)
+                    self._adapted = True
+                for p in range(
+                        self.inner.output_partitioning().num_partitions):
+                    for _ in self.inner.execute(p):
+                        pass  # drain: ANALYZE reports metrics, not rows
+            finally:
+                cancel_plan(self.inner)
         total = _time.perf_counter() - t0
         # one batched device_get for every operator's pending row counts
         # (pretty_metrics would otherwise pay one transfer per operator)

@@ -71,6 +71,14 @@ class JoinExec(PhysicalPlan):
         #               codec tables, build keys, build live)
         self._build_data = {}
         self._remap_cache = {}
+        # concurrent partition execution (ingest iter_partitions): a
+        # merged build is shared by every partition (key 0) and must
+        # materialize exactly once — the heavy device work makes this
+        # NOT a benign race. Per-KEY locks so a partitioned join's
+        # independent per-partition builds still overlap.
+        from ..ingest import KeyedLocks
+
+        self._build_locks = KeyedLocks()
 
     def _signature_parts(self) -> tuple:
         # partitioned/adaptive_note steer HOST orchestration only — no
@@ -285,15 +293,22 @@ class JoinExec(PhysicalPlan):
 
     def _materialize_build(self, partition: int = 0):
         key = partition if self.partitioned else 0
+        if key in self._build_data:  # fast path, no lock once built
+            return self._build_data[key]
+        with self._build_locks.get(key):
+            return self._materialize_build_locked(key, partition)
+
+    def _materialize_build_locked(self, key: int, partition: int):
         if key in self._build_data:
             return self._build_data[key]
         if self.partitioned:
             batches = list(self.build.execute(partition))
         else:
-            nparts = self.build.output_partitioning().num_partitions
-            batches = []
-            for p in range(nparts):
-                batches.extend(self.build.execute(p))
+            from ..ingest import iter_partitions
+
+            batches = list(iter_partitions(
+                self.build,
+                range(self.build.output_partitioning().num_partitions)))
         if not batches:
             if self.partitioned:  # a hash partition may be empty
                 batches = [self._empty_build_batch()]

@@ -11,6 +11,7 @@ between them).
 
 from __future__ import annotations
 
+import threading
 from typing import Iterator, List, Optional, Sequence
 
 import jax
@@ -55,13 +56,26 @@ def compute_partition_ids(batch: ColumnBatch, hash_exprs, num_partitions: int,
 
 class ScanExec(PhysicalPlan):
     """Table scan over a partitioned source (reference: CsvScanExecNode /
-    ParquetScanExecNode, ballista.proto:334-354)."""
+    ParquetScanExecNode, ballista.proto:334-354).
+
+    Execution rides the ingest pipeline (ballista_tpu/ingest): with
+    ``BALLISTA_PREFETCH_BATCHES`` > 0 the source generator runs on a
+    pool worker behind a bounded queue, so parse+H2D of chunk N+1
+    overlaps the consumer's device compute on chunk N, and scans
+    ``prime()``d ahead (client/executor collect paths) overlap each
+    other cross-table. ``BALLISTA_PREFETCH_BATCHES=0`` restores the
+    serial inline pull exactly."""
 
     def __init__(self, table_name: str, source: TableSource,
                  projection: Optional[Sequence[str]] = None):
         self.table_name = table_name
         self.source = source
         self.projection = tuple(projection) if projection is not None else None
+        # partition -> live PrefetchHandle (primed ahead of execution);
+        # the lock covers priming from the collect thread racing an
+        # executor worker's execute()
+        self._primed: dict = {}
+        self._primed_lock = threading.Lock()
 
     def output_schema(self) -> Schema:
         s = self.source.table_schema()
@@ -74,8 +88,76 @@ class ScanExec(PhysicalPlan):
         assert not children
         return self
 
+    def _recorder(self):
+        from ..ingest.phases import PhaseRecorder
+        from ..observability.metrics import metrics_enabled
+
+        return PhaseRecorder(self.metrics() if metrics_enabled() else None)
+
+    def _prefetchable(self, partition: int) -> bool:
+        """False when there is no parse/H2D to overlap: memory-resident
+        sources, and cache sources already materialized for this
+        (partition, projection) — the warm path stays queue-free."""
+        from ..io.cache import CacheSource
+        from ..io.memory import MemTableSource
+
+        src = self.source
+        if isinstance(src, MemTableSource):
+            return False
+        if isinstance(src, CacheSource) and \
+                src.is_materialized(partition, self.projection):
+            return False
+        return True
+
+    def prime(self, partition: int):
+        """Start background parse+H2D for one partition on the ingest
+        pool (idempotent). Returns the handle, or None when the
+        pipeline is gated off or there is nothing to overlap."""
+        from ..ingest import prefetch_batches
+        from ..ingest.pipeline import PrefetchHandle
+
+        depth = prefetch_batches()
+        if depth <= 0 or not self._prefetchable(partition):
+            return None
+        with self._primed_lock:
+            h = self._primed.get(partition)
+            if h is None:
+                h = PrefetchHandle(
+                    lambda p=partition: self.source.scan(p, self.projection),
+                    depth,
+                    label=f"{self.table_name}[{partition}]",
+                    recorder=self._recorder(),
+                )
+                self._primed[partition] = h
+        return h
+
+    def cancel_primed(self) -> None:
+        """Drop every unconsumed primed handle (plan abandoned or
+        rewritten away): producers stop, queued batches release."""
+        with self._primed_lock:
+            handles, self._primed = list(self._primed.values()), {}
+        for h in handles:
+            h.cancel()
+
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
-        yield from self.source.scan(partition, self.projection)
+        from ..ingest import prefetch_batches
+        from ..ingest.phases import bound_iter
+
+        if prefetch_batches() > 0:
+            self.prime(partition)  # no-op when nothing to overlap
+        with self._primed_lock:
+            handle = self._primed.pop(partition, None)
+        if handle is None:  # pipeline off: the old serial pull loop
+            yield from bound_iter(
+                self.source.scan(partition, self.projection),
+                self._recorder())
+            return
+        try:
+            yield from handle
+        finally:
+            # consumer may abandon the stream early (LimitExec): stop
+            # the producer instead of leaving it blocked on a full queue
+            handle.cancel()
 
     def estimated_rows(self):
         return self.source.estimated_rows()
@@ -159,8 +241,14 @@ class MergeExec(PhysicalPlan):
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
         if partition != 0:
             raise ExecutionError("MergeExec has a single output partition")
-        for p in range(self.child.output_partitioning().num_partitions):
-            yield from self.child.execute(p)
+        from ..ingest import iter_partitions
+
+        # pipelined: child partitions (each a whole scan/join/partial-agg
+        # subtree) produce concurrently on the ingest pool, merged in
+        # partition order — the serial pull loop when gated off
+        yield from iter_partitions(
+            self.child,
+            range(self.child.output_partitioning().num_partitions))
 
     def display(self) -> str:
         return "MergeExec"
@@ -303,6 +391,11 @@ class RepartitionExec(PhysicalPlan):
         self.hash_exprs = hash_exprs
         self._ev = Evaluator(child.output_schema())
         self._cache: Optional[List[ColumnBatch]] = None
+        # concurrent partition execution (ingest iter_partitions, the
+        # cluster analogue of which is per-task plan instances) must
+        # materialize exactly once; RLock: _materialize_parts calls
+        # _materialize
+        self._mat_lock = threading.RLock()
 
     def _signature_parts(self) -> tuple:
         return (self.num_partitions, fingerprint(self.hash_exprs),
@@ -334,18 +427,36 @@ class RepartitionExec(PhysicalPlan):
                                      self._ev)
 
     def _materialize(self) -> List[ColumnBatch]:
-        if self._cache is None:
-            out = []
-            for p in range(self.child.output_partitioning().num_partitions):
-                out.extend(self.child.execute(p))
-            self._cache = out
-        return self._cache
+        with self._mat_lock:
+            if self._cache is None:
+                from ..ingest import iter_partitions
+
+                self._cache = list(iter_partitions(
+                    self.child,
+                    range(self.child.output_partitioning()
+                          .num_partitions)))
+            return self._cache
 
     def _materialize_parts(self):
         """Materialize once and sort each batch by destination partition
         ONCE (not once per output partition): partition p is then a
-        contiguous slice of the permutation. [(batch, perm, counts)]"""
+        contiguous slice of the permutation. [(batch, perm, counts)]
+
+        With the ingest pipeline on, the per-batch host syncs are
+        DEFERRED: every batch's sort is dispatched back-to-back and the
+        count scalars resolve in one ``jax.device_get`` at the end, so
+        the device never waits on the host between batches (hash
+        repartitions don't read the row offset at all — only
+        round-robin does, and it needs the per-batch row count on
+        host). ``BALLISTA_PREFETCH_BATCHES=0`` restores the serial
+        sync-per-batch loop."""
+        with self._mat_lock:
+            return self._materialize_parts_locked()
+
+    def _materialize_parts_locked(self):
         if getattr(self, "_parts", None) is None:
+            from ..ingest import prefetch_batches
+
             def build():
                 tw = self.trace_twin()  # don't pin materialized batches
                 n_out = tw.num_partitions
@@ -362,12 +473,32 @@ class RepartitionExec(PhysicalPlan):
                 return sort_by_pid
 
             mask_fn = self.governed_jit(("repart.sort_by_pid",), build)
-            parts = []
-            offset = 0
-            for batch in self._materialize():
-                perm, counts = mask_fn(batch, jnp.int32(offset))
-                parts.append((batch, perm, np.asarray(counts)))
-                offset += batch.num_rows_host()
+            pipelined = prefetch_batches() > 0 and self.hash_exprs
+            batches = self._materialize()
+            if pipelined:
+                from ..ingest import parallel_map
+
+                # offset is unread by hash partitioning, so batches are
+                # independent: the first sorts inline (the governed
+                # entry traces exactly once), the rest dispatch from
+                # pool workers — independent XLA executions genuinely
+                # overlap across cores — and every count scalar
+                # resolves in ONE device_get
+                zero = jnp.int32(0)
+                pairs = ([mask_fn(batches[0], zero)] if batches else [])
+                pairs += parallel_map(lambda b: mask_fn(b, zero),
+                                      batches[1:])
+                resolved = jax.device_get([c for _, c in pairs])
+                parts = [(b, perm, np.asarray(c))
+                         for b, (perm, _), c in zip(batches, pairs,
+                                                    resolved)]
+            else:
+                parts = []
+                offset = 0
+                for batch in batches:
+                    perm, counts = mask_fn(batch, jnp.int32(offset))
+                    parts.append((batch, perm, np.asarray(counts)))
+                    offset += batch.num_rows_host()
             self._parts = parts
         return self._parts
 

@@ -15,6 +15,7 @@ unresolved_shuffle.rs}):
 from __future__ import annotations
 
 import os
+import threading
 from typing import Iterator, List, Optional
 
 from ..columnar import ColumnBatch
@@ -113,6 +114,20 @@ class ShuffleReaderExec(PhysicalPlan):
         self.partition_locations = list(partition_locations)
         self._schema = schema
         self._cache = {}
+        # ingest read-ahead: group index -> in-flight Future loading it
+        # behind the consumer (see execute()). _group_locks serialize a
+        # group's load so a read-ahead racing a direct consumer never
+        # fetches the same files twice; _served gates read-ahead to
+        # instances that actually iterate multiple partitions (a cluster
+        # task deserializes its own plan and executes exactly ONE
+        # partition — read-ahead there would fetch a neighbour task's
+        # group into a cache that dies with this task)
+        from ..ingest import KeyedLocks
+
+        self._inflight = {}
+        self._inflight_lock = threading.Lock()
+        self._group_locks = KeyedLocks()
+        self._served = False
         # read_partitions: List[List[(out_lo, out_hi, prod_lo, prod_hi)]],
         # producer_hi == 0 selecting all producers (adaptive/rules.py)
         self.read_partitions = (
@@ -184,31 +199,85 @@ class ShuffleReaderExec(PhysicalPlan):
     def with_new_children(self, children):
         return self
 
-    def _load_group(self, q: int) -> List[ColumnBatch]:
-        """Fetch only THIS output partition's files (a consumer task reads
-        its own group, not the whole shuffle). utf8 dictionaries are
-        unioned within the group; cross-group concat is handled by
-        concat_batches' dictionary unification."""
-        if q in self._cache:
-            return self._cache[q]
+    def _load_location(self, loc: PartitionLocation):
+        """Fetch+decode ONE shuffle file (local filesystem or data-plane
+        socket). Runs on ingest pool workers when a group has several
+        producers — the fetches overlap instead of serializing one
+        network round-trip per producer. Metric increments from worker
+        threads ride the usual benign-race policy."""
         from ..io import ipc
 
         m = self.metrics()
-        parts = []
-        for loc in self._groups[q]:
-            if not self.FORCE_REMOTE and loc.path and os.path.exists(loc.path):
-                m.add_counter("bytes_read", os.path.getsize(loc.path))
-                m.add_counter("local_reads")
-                _, arrays, nulls, dicts, _ = ipc.read_partition_arrays(loc.path)
-            else:
-                buf = self._fetch_with_retry(loc)
-                m.add_counter("bytes_read", len(buf))
-                m.add_counter("remote_fetches")
-                _, arrays, nulls, dicts, _ = ipc.read_partition_arrays(buf)
-            parts.append((arrays, nulls, dicts))
-        batches = ipc.batches_from_parts(self._schema, parts)
-        self._cache[q] = batches
-        return batches
+        if not self.FORCE_REMOTE and loc.path and os.path.exists(loc.path):
+            m.add_counter("bytes_read", os.path.getsize(loc.path))
+            m.add_counter("local_reads")
+            _, arrays, nulls, dicts, _ = ipc.read_partition_arrays(loc.path)
+        else:
+            buf = self._fetch_with_retry(loc)
+            m.add_counter("bytes_read", len(buf))
+            m.add_counter("remote_fetches")
+            _, arrays, nulls, dicts, _ = ipc.read_partition_arrays(buf)
+        return arrays, nulls, dicts
+
+    def _load_group(self, q: int) -> List[ColumnBatch]:
+        """Fetch only THIS output partition's files (a consumer task reads
+        its own group, not the whole shuffle), producers fetched
+        concurrently on the ingest pool. Per-group locking: a read-ahead
+        racing the direct consumer loads once, the loser serves from the
+        cache. utf8 dictionaries are unioned within the group;
+        cross-group concat is handled by concat_batches' dictionary
+        unification."""
+        if q in self._cache:  # fast path once loaded
+            return self._cache[q]
+        with self._group_locks.get(q):
+            if q in self._cache:
+                return self._cache[q]
+            from ..io import ipc
+            from ..ingest import parallel_map
+
+            parts = parallel_map(self._load_location, self._groups[q])
+            batches = ipc.batches_from_parts(self._schema, parts)
+            self._cache[q] = batches
+            return batches
+
+    def _take_group(self, q: int) -> List[ColumnBatch]:
+        """Serve group ``q``, joining a read-ahead future if one is in
+        flight (its exceptions surface here, on the consumer). The
+        cancel-or-inline rule applies: a future the pool never started
+        is cancelled and loaded inline — blocking on it from a pool
+        worker (readers execute on ingest producers under MergeExec)
+        would deadlock an exhausted pool."""
+        with self._inflight_lock:
+            fut = self._inflight.pop(q, None)
+        if fut is not None and not fut.cancel():
+            return fut.result()
+        return self._load_group(q)
+
+    def _bg_load(self, q: int) -> List[ColumnBatch]:
+        """Read-ahead body: load, then drop the inflight registration
+        (an unconsumed future must not pin itself forever)."""
+        try:
+            return self._load_group(q)
+        finally:
+            with self._inflight_lock:
+                self._inflight.pop(q, None)
+
+    def _read_ahead(self, q: int) -> None:
+        """Start loading group ``q`` behind the consumer (merge-style
+        multi-partition readers: partition N+1 fetches while N's rows
+        are being joined/aggregated). Only fires once this INSTANCE has
+        demonstrably served more than one partition — a cluster task's
+        single-partition reader must not fetch a neighbour task's
+        group. Best-effort and bounded by the shared ingest pool."""
+        from ..ingest import ingest_pool, prefetch_batches
+
+        if (not self._served or prefetch_batches() <= 0
+                or q >= len(self._groups) or q in self._cache):
+            return
+        with self._inflight_lock:
+            if q in self._inflight:
+                return
+            self._inflight[q] = ingest_pool().submit(self._bg_load, q)
 
     def _fetch_with_retry(self, loc: PartitionLocation) -> bytes:
         """One quick retry rides out transient hiccups; a persistent
@@ -250,7 +319,10 @@ class ShuffleReaderExec(PhysicalPlan):
         )
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
-        yield from self._load_group(partition)
+        batches = self._take_group(partition)
+        self._read_ahead(partition + 1)
+        self._served = True
+        yield from batches
 
     def display(self) -> str:
         out = f"ShuffleReaderExec: {len(self.partition_locations)} partitions"

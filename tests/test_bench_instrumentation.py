@@ -36,3 +36,43 @@ def test_instrument_q1_populates_stages(tiny_data):
     assert out["rows"] > 0
     assert out["kernel_s"] > 0
     assert out["kernel_rows_per_s"] > 0
+
+
+def test_cold_phase_split_fields(tiny_data, monkeypatch):
+    """bench.cold_phase_split (the source of the parse_seconds /
+    h2d_seconds / execute_seconds JSON fields) must populate all phase
+    fields, and — with the ingest pipeline gated off, where phase time
+    is consumer-thread time — they must sum to the wall time."""
+    from ballista_tpu import ingest
+
+    monkeypatch.setenv("BALLISTA_INGEST_THREADS", "1")
+    monkeypatch.setenv("BALLISTA_PREFETCH_BATCHES", "0")
+    ingest.reconfigure()
+    try:
+        import bench
+        from ballista_tpu.client import BallistaContext
+        from benchmarks.tpch.schema_def import TPCH_PKS, TPCH_SCHEMAS
+
+        ctx = BallistaContext.standalone()
+        ctx.register_tbl("lineitem", os.path.join(tiny_data, "lineitem"),
+                         TPCH_SCHEMAS["lineitem"],
+                         primary_key=TPCH_PKS["lineitem"])
+        sql = open(os.path.join(os.path.dirname(__file__), "..",
+                                "benchmarks", "tpch", "queries",
+                                "q1.sql")).read()
+        _, phases = bench.cold_phase_split(
+            lambda: ctx.sql(sql).collect())
+    finally:
+        monkeypatch.undo()
+        ingest.reconfigure()
+    for key in ("wall_seconds", "parse_seconds", "h2d_seconds",
+                "execute_seconds"):
+        assert key in phases, f"missing {key}: {phases}"
+        assert phases[key] >= 0
+    assert phases["parse_seconds"] > 0
+    assert phases["h2d_seconds"] > 0
+    total = (phases["parse_seconds"] + phases["h2d_seconds"]
+             + phases["execute_seconds"])
+    wall = phases["wall_seconds"]
+    # serial mode: parse + h2d + execute ≈ wall (rounding noise only)
+    assert abs(total - wall) <= max(0.05 * wall, 0.02), phases

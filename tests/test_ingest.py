@@ -1,0 +1,339 @@
+"""Pipelined parallel ingest (ballista_tpu/ingest): determinism, memory
+bounds, cross-table overlap, cache-source concurrency, observability.
+
+The pipeline reorders TIMING, never rows: TPC-H results must be
+byte-identical with the pipeline ON vs OFF and at any thread count
+(same style as tests/test_mt_scan.py's single- vs multi-thread sweep).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ballista_tpu import schema, Int64, Utf8
+
+
+QDIR = os.path.join(os.path.dirname(__file__), "..", "benchmarks", "tpch",
+                    "queries")
+
+
+def _configure(monkeypatch, threads, prefetch):
+    from ballista_tpu import ingest
+
+    monkeypatch.setenv("BALLISTA_INGEST_THREADS", str(threads))
+    monkeypatch.setenv("BALLISTA_PREFETCH_BATCHES", str(prefetch))
+    ingest.reconfigure()
+
+
+@pytest.fixture(autouse=True)
+def _restore_ingest_config(monkeypatch):
+    """Every test leaves the process with env-default ingest config."""
+    from ballista_tpu import ingest
+
+    yield
+    monkeypatch.undo()
+    ingest.reconfigure()
+
+
+# ---------------------------------------------------------------------------
+# determinism sweep: pipeline ON vs OFF, 1 vs 4 threads
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tpch_dir(tmp_path_factory):
+    from benchmarks.tpch import datagen
+
+    d = str(tmp_path_factory.mktemp("ingest_tpch"))
+    datagen.generate(d, scale=0.002, num_parts=2)
+    return d
+
+
+def _run_tpch(data_dir, qname):
+    from ballista_tpu.client import BallistaContext
+    from benchmarks.tpch.schema_def import register_tpch
+
+    ctx = BallistaContext.standalone()
+    register_tpch(ctx, data_dir, "tbl")
+    sql = open(os.path.join(QDIR, f"{qname}.sql")).read()
+    return ctx.sql(sql).collect()
+
+
+def _assert_byte_identical(a, b, tag):
+    assert list(a.columns) == list(b.columns), tag
+    assert len(a) == len(b), tag
+    for c in a.columns:
+        ga, gb = a[c].to_numpy(), b[c].to_numpy()
+        assert ga.dtype == gb.dtype, f"{tag}.{c}: {ga.dtype} vs {gb.dtype}"
+        if ga.dtype.kind in "fc":  # byte-identical, not merely close
+            assert ga.tobytes() == gb.tobytes(), f"{tag}.{c}"
+        else:
+            np.testing.assert_array_equal(ga, gb, err_msg=f"{tag}.{c}")
+
+
+@pytest.mark.parametrize("qname", ["q1", "q5"])
+def test_determinism_pipeline_on_off(tpch_dir, monkeypatch, qname):
+    """q1 (chunked agg scan) and q5 (8-table join tree + AQE) must be
+    byte-identical across serial / single-thread / wide configs."""
+    _configure(monkeypatch, 1, 0)  # serial baseline (pipeline OFF)
+    base = _run_tpch(tpch_dir, qname)
+    for threads in (1, 4):
+        _configure(monkeypatch, threads, 2)
+        got = _run_tpch(tpch_dir, qname)
+        _assert_byte_identical(base, got, f"{qname}[threads={threads}]")
+
+
+# ---------------------------------------------------------------------------
+# bounded memory: the prefetch queue never exceeds its configured depth
+# ---------------------------------------------------------------------------
+
+
+def _write_tbl(tmp_path, rows=1024):
+    p = tmp_path / "t.tbl"
+    p.write_text("".join(f"{i}|k{i % 13}|\n" for i in range(rows)))
+    return str(p)
+
+
+SCHEMA = schema(("a", Int64), ("c", Utf8))
+
+
+def test_prefetch_queue_bounded(tmp_path, monkeypatch):
+    """A slow consumer must cap the producer at the configured depth —
+    the pipeline trades bounded extra memory for overlap, never
+    unbounded buffering."""
+    _configure(monkeypatch, 2, 2)
+    from ballista_tpu.ingest import PrefetchHandle, prefetch_batches
+    from ballista_tpu.io import TblSource
+
+    assert prefetch_batches() == 2
+    src = TblSource(_write_tbl(tmp_path), SCHEMA, batch_capacity=128)
+    handle = PrefetchHandle(lambda: src.scan(0), depth=2, label="t[0]")
+    got = 0
+    for batch in handle:
+        time.sleep(0.02)  # consumer slower than the parser
+        got += 1
+    assert got == 8  # 1024 rows / 128-capacity chunks
+    assert handle.max_occupancy <= 2, handle.max_occupancy
+
+
+def test_prefetch_cancel_stops_producer(tmp_path, monkeypatch):
+    """A consumer abandoning the stream early (LimitExec) must not leave
+    the producer blocked on a full queue."""
+    _configure(monkeypatch, 2, 1)
+    from ballista_tpu.io import TblSource
+    from ballista_tpu.physical.operators import ScanExec
+
+    src = TblSource(_write_tbl(tmp_path), SCHEMA, batch_capacity=128)
+    scan = ScanExec("t", src)
+    it = scan.execute(0)
+    next(it)
+    it.close()  # abandon: GeneratorExit runs ScanExec's finally
+    with scan._primed_lock:
+        assert not scan._primed
+    # the shared pool must be usable afterwards (producer exited)
+    from ballista_tpu.ingest import ingest_pool
+
+    assert ingest_pool().submit(lambda: 42).result(timeout=10) == 42
+
+
+# ---------------------------------------------------------------------------
+# cross-table overlap: primed scans parse CONCURRENTLY
+# ---------------------------------------------------------------------------
+
+
+def test_primed_scans_parse_concurrently(monkeypatch):
+    """Two primed leaf scans rendezvous at a barrier inside their scan
+    bodies: only concurrent producers can both arrive (a serial pull
+    loop would break the barrier's timeout)."""
+    _configure(monkeypatch, 2, 1)
+    from ballista_tpu.columnar import ColumnBatch
+    from ballista_tpu.logical import TableSource
+    from ballista_tpu.physical.operators import ScanExec
+
+    barrier = threading.Barrier(2)
+    sch = schema(("a", Int64))
+
+    class RendezvousSource(TableSource):
+        def table_schema(self):
+            return sch
+
+        def num_partitions(self):
+            return 1
+
+        def source_descriptor(self):
+            return {"kind": "memory"}
+
+        def scan(self, partition, projection=None):
+            barrier.wait(timeout=30)  # fails the test if run serially
+            yield ColumnBatch.from_pydict(sch, {"a": [1, 2, 3]})
+
+    scans = [ScanExec(f"t{i}", RendezvousSource()) for i in range(2)]
+    from ballista_tpu.ingest import prime_plan
+
+    for s in scans:
+        assert prime_plan(s) == 1
+    for s in scans:
+        batches = list(s.execute(0))
+        assert int(batches[0].num_rows) == 3
+
+
+def test_iter_partitions_preserves_order(monkeypatch):
+    """Concurrent partition production must still yield partition 0's
+    batches first, then 1's, ... — the merge order (and therefore every
+    result) is identical to the serial loop even when later partitions
+    finish producing first."""
+    _configure(monkeypatch, 4, 2)
+    from ballista_tpu.ingest import iter_partitions
+    from ballista_tpu.physical.base import Partitioning, PhysicalPlan
+
+    sch = schema(("a", Int64))
+
+    class TaggedPlan(PhysicalPlan):
+        def output_schema(self):
+            return sch
+
+        def output_partitioning(self):
+            return Partitioning("unknown", 3)
+
+        def with_new_children(self, children):
+            return self
+
+        def execute(self, partition):
+            from ballista_tpu.columnar import ColumnBatch
+
+            # later partitions finish FIRST if order were by completion
+            time.sleep((3 - partition) * 0.05)
+            for chunk in range(2):
+                yield ColumnBatch.from_pydict(
+                    sch, {"a": [partition * 10 + chunk]})
+
+    out = [int(np.asarray(b.columns[0].values)[0])
+           for b in iter_partitions(TaggedPlan(), range(3))]
+    assert out == [0, 1, 10, 11, 20, 21]
+
+
+# ---------------------------------------------------------------------------
+# CacheSource: concurrent scans of one key materialize the inner scan once
+# ---------------------------------------------------------------------------
+
+
+def test_cache_source_concurrent_single_materialization(monkeypatch):
+    from ballista_tpu.columnar import ColumnBatch
+    from ballista_tpu.io import CacheSource
+    from ballista_tpu.logical import TableSource
+
+    sch = schema(("a", Int64))
+    calls = []
+
+    class CountingSource(TableSource):
+        def table_schema(self):
+            return sch
+
+        def num_partitions(self):
+            return 1
+
+        def source_descriptor(self):
+            return {"kind": "memory"}
+
+        def scan(self, partition, projection=None):
+            calls.append(partition)
+            time.sleep(0.05)  # widen the race window
+            yield ColumnBatch.from_pydict(sch, {"a": list(range(10))})
+
+    cache = CacheSource(CountingSource())
+    results, errors = [], []
+
+    def worker():
+        try:
+            results.append(list(cache.scan(0)))
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    assert len(calls) == 1, f"inner scan ran {len(calls)} times"
+    assert len(results) == 4
+    for batches in results:
+        assert len(batches) == 1
+        assert int(batches[0].num_rows) == 10
+
+
+# ---------------------------------------------------------------------------
+# observability: phase split in metrics/EXPLAIN ANALYZE + trace spans
+# ---------------------------------------------------------------------------
+
+
+def test_phase_split_in_explain_analyze(tmp_path, monkeypatch):
+    _configure(monkeypatch, 2, 2)
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.io import TblSource
+
+    ctx = BallistaContext.standalone()
+    ctx.register_source("t", TblSource(_write_tbl(tmp_path), SCHEMA))
+    txt = ctx.sql(
+        "SELECT c, count(*) AS n FROM t GROUP BY c").explain_analyze()
+    assert "elapsed_parse" in txt, txt
+    assert "elapsed_h2d" in txt, txt
+
+    # the same split rides last_query_metrics()'s scan operator row
+    # (plain collect: the ANALYZE node presents as a leaf)
+    ctx.sql("SELECT sum(a) AS s FROM t").collect()
+    qm = ctx.last_query_metrics()
+    scan_rows = [r for r in qm.operators()
+                 if r["operator"].startswith("ScanExec")]
+    assert scan_rows
+    assert any("elapsed_parse" in r["metrics"] for r in scan_rows)
+
+
+def test_ingest_trace_spans(tmp_path, monkeypatch):
+    import json
+
+    from ballista_tpu.observability import tracing
+
+    trace_file = str(tmp_path / "trace.jsonl")
+    monkeypatch.setenv("BALLISTA_TRACE", "1")
+    monkeypatch.setenv("BALLISTA_TRACE_FILE", trace_file)
+    tracing.reconfigure()
+    _configure(monkeypatch, 2, 2)
+    try:
+        from ballista_tpu.client import BallistaContext
+        from ballista_tpu.io import TblSource
+
+        ctx = BallistaContext.standalone()
+        ctx.register_source("t", TblSource(_write_tbl(tmp_path), SCHEMA))
+        ctx.sql("SELECT sum(a) AS s FROM t").collect()
+    finally:
+        monkeypatch.delenv("BALLISTA_TRACE")
+        monkeypatch.delenv("BALLISTA_TRACE_FILE")
+        tracing.reconfigure()
+    spans = [json.loads(l) for l in open(trace_file)]
+    names = {s["name"] for s in spans}
+    assert "ingest.parse" in names, names
+    assert "ingest.h2d" in names, names
+    assert "ingest.prime" in names, names
+    # parse spans carry their producer thread id, making overlap
+    # observable (not inferred) in the trace
+    parse = [s for s in spans if s["name"] == "ingest.parse"]
+    assert all("tid" in s and "dur" in s for s in parse)
+
+
+def test_phase_totals_accumulate(tmp_path, monkeypatch):
+    _configure(monkeypatch, 1, 0)  # serial: phases still recorded
+    from ballista_tpu import ingest
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.io import TblSource
+
+    before = ingest.phase_totals()
+    ctx = BallistaContext.standalone()
+    ctx.register_source("t", TblSource(_write_tbl(tmp_path), SCHEMA))
+    ctx.sql("SELECT sum(a) AS s FROM t").collect()
+    after = ingest.phase_totals()
+    assert after["parse"] > before["parse"]
+    assert after["h2d"] > before["h2d"]
