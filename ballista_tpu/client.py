@@ -225,7 +225,51 @@ class BallistaContext:
         """Shared standalone execute-and-wrap: plan (unless the caller
         passes a cached physical plan), execute, record metrics.
         Returns ``(frame, phys)`` so DataFrame.collect can keep its
-        plan cache."""
+        plan cache. Under ``BALLISTA_PROFILE=<dir>`` every collect
+        writes a Chrome-trace profile artifact into the directory."""
+        from .observability import profiler as obs_profiler
+
+        out_dir = obs_profiler.profile_dir()
+        if out_dir is not None and not obs_profiler.profiling_active():
+            # label artifacts by a plan digest so a bench loop's files
+            # are distinguishable per query shape
+            import hashlib
+
+            try:
+                profile_label = ("query-" + hashlib.sha1(
+                    plan.pretty().encode()).hexdigest()[:10])
+            except Exception:  # noqa: BLE001 - label is cosmetic
+                profile_label = "query"
+            box = {}
+
+            def run():
+                box["r"] = self._standalone_collect_inner(plan, phys)
+
+            import logging
+
+            plog = logging.getLogger("ballista.profiler")
+            try:
+                _, path = obs_profiler.profile_call(
+                    run, label=profile_label,
+                    plan_getter=lambda: box.get("r", (None, None))[1],
+                    out_dir=out_dir, busy_ok=True,
+                )
+            except Exception:
+                if "r" not in box:
+                    raise  # the QUERY failed: propagate as usual
+                # the query succeeded and only the artifact write/stop
+                # failed (e.g. unwritable BALLISTA_PROFILE path): a
+                # misconfigured observability knob must not cost the
+                # caller their result
+                plog.exception("profile artifact write failed; "
+                               "returning the query result anyway")
+                path = None
+            if path is not None:
+                plog.info("profile artifact written: %s", path)
+            return box["r"]
+        return self._standalone_collect_inner(plan, phys)
+
+    def _standalone_collect_inner(self, plan: LogicalPlan, phys=None):
         import pandas as pd
 
         from .execution import collect_physical, plan_logical
@@ -436,6 +480,38 @@ class DataFrame:
 
     def to_pandas(self):
         return self.collect()
+
+    def profile(self, path: Optional[str] = None,
+                label: Optional[str] = None) -> str:
+        """Execute the frame under the query profiler and write ONE
+        Chrome-trace/Perfetto-compatible artifact (trace spans + ingest
+        phases + compile attribution + per-operator metrics + named
+        wall-time lanes). Returns the artifact path. Standalone mode
+        only — cluster queries are profiled per process via
+        ``BALLISTA_TRACE`` on the scheduler/executors."""
+        if self.ctx.mode != "standalone":
+            raise BallistaError(
+                "profile() runs standalone queries; for cluster queries "
+                "enable BALLISTA_TRACE on the scheduler/executor "
+                "processes and merge their trace files")
+        from .observability import profiler as obs_profiler
+
+        box = {}
+
+        def run():
+            out, phys = self.ctx._standalone_collect_inner(
+                self.plan, phys=self._phys)
+            self._phys = phys
+            box["phys"] = phys
+            return out
+
+        _, artifact = obs_profiler.profile_call(
+            run, label=label or "query",
+            plan_getter=lambda: box.get("phys"),
+            out_path=path,
+            out_dir=obs_profiler.profile_dir(),
+        )
+        return artifact
 
     def count(self) -> int:
         agg = Aggregate([], [ex.count().alias("__n")], self.plan)

@@ -111,11 +111,28 @@ class Dictionary:
     ride in pytree aux-data without defeating jit caching.
     """
 
-    __slots__ = ("values", "_index")
+    __slots__ = ("values", "_index", "_tracked_bytes")
 
     def __init__(self, values: Sequence[str]):
         self.values: np.ndarray = np.asarray(list(values), dtype=object)
         self._index: Dict[str, int] = {v: i for i, v in enumerate(self.values)}
+        # memory accounting (observability/memory.py): dictionaries are
+        # the dominant host-resident string mass — ~pointer array +
+        # index dict entry + string storage per value (estimate, not an
+        # allocator truth; released in __del__)
+        self._tracked_bytes = int(self.values.nbytes) + 120 * len(self.values)
+        from .observability import memory as _obs_memory
+
+        _obs_memory.record_host_bytes("dictionaries", self._tracked_bytes)
+
+    def __del__(self):
+        try:
+            from .observability import memory as _obs_memory
+
+            _obs_memory.release_host_bytes("dictionaries",
+                                           self._tracked_bytes)
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
 
     def __len__(self) -> int:
         return len(self.values)
@@ -358,11 +375,15 @@ class ColumnBatch:
         copies issued together, then awaited) — per-column ``np.asarray``
         would serialize a device->host round-trip per array, which
         dominates query latency when the accelerator is remote."""
-        sel, vals, valids = jax.device_get((
-            self.selection,
-            [c.values for c in self.columns],
-            [c.validity for c in self.columns],
-        ))
+        from .observability.tracing import trace_span
+
+        with trace_span("device.block", site="batch.to_pydict",
+                        columns=len(self.columns)):
+            sel, vals, valids = jax.device_get((
+                self.selection,
+                [c.values for c in self.columns],
+                [c.validity for c in self.columns],
+            ))
         mask = np.asarray(sel)
         out: Dict[str, np.ndarray] = {}
         for f, col, v, va in zip(self.schema.fields, self.columns, vals,

@@ -54,7 +54,8 @@ class ExecutorConfig:
                  scheduler_port: int = 50050,
                  bind_host: Optional[str] = None,
                  num_devices: int = 1,
-                 native_dataplane: Optional[bool] = None):
+                 native_dataplane: Optional[bool] = None,
+                 metrics_port: Optional[int] = None):
         # host = the address peers should dial (advertised in PollWork);
         # bind_host = the local interface the data plane listens on.
         # Distinct so NAT/port-forward setups can bind 0.0.0.0 while
@@ -72,6 +73,10 @@ class ExecutorConfig:
         self.concurrent_tasks = concurrent_tasks
         self.scheduler_host = scheduler_host
         self.scheduler_port = scheduler_port
+        # health plane port: None = resolve BALLISTA_METRICS_PORT
+        # (default off for in-process executors; the binary defaults it
+        # to 0 = ephemeral ON); < 0 disables
+        self.metrics_port = metrics_port
 
 
 class Executor:
@@ -97,6 +102,61 @@ class Executor:
         self._pending_status = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # health plane: task counters (benign-race ints under the GIL,
+        # same policy as observability.metrics), a ring of recent task
+        # summaries, and — when enabled — /healthz + /metrics +
+        # /debug/queries on a local stdlib HTTP server
+        self._inflight = 0
+        self.tasks_completed = 0
+        self.tasks_failed = 0
+        from ..observability.health import (QueryLog,
+                                            maybe_start_health_server,
+                                            metrics_port_from_env)
+
+        self._query_log = QueryLog()
+        mport = config.metrics_port
+        if mport is None:
+            mport = metrics_port_from_env(-1)
+        self._health = maybe_start_health_server(
+            "executor", mport, samples_fn=self._metric_samples,
+            query_log=self._query_log,
+        )
+
+    @property
+    def health_port(self) -> Optional[int]:
+        return self._health.port if self._health is not None else None
+
+    def resource_gauges(self) -> dict:
+        """Current resource gauges: shipped with every heartbeat and
+        exported on the local /metrics."""
+        from ..ingest import pool_queue_depth
+        from ..observability import memory as obs_memory
+
+        return {
+            "rss_bytes": obs_memory.rss_bytes(),
+            "device_bytes": obs_memory.device_bytes(),
+            # clamped: the counter is a benign-race int (same policy as
+            # the task counters), but a lost update must never drive a
+            # negative into the uint32 proto field — that would make
+            # every subsequent heartbeat raise and starve the executor
+            "inflight_tasks": max(0, self._inflight),
+            "ingest_pool_depth": pool_queue_depth(),
+            "peak_host_bytes": obs_memory.peak_host_bytes(),
+        }
+
+    def _metric_samples(self):
+        # only the executor-specific gauges: rss/device/peak are
+        # appended by the health server's base process samples — going
+        # through resource_gauges() here would sample them twice per
+        # scrape
+        from ..ingest import pool_queue_depth
+
+        return [
+            ("ballista_inflight_tasks", {}, max(0, self._inflight)),
+            ("ballista_ingest_pool_depth", {}, pool_queue_depth()),
+            ("ballista_tasks_completed_total", {}, self.tasks_completed),
+            ("ballista_tasks_failed_total", {}, self.tasks_failed),
+        ]
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -112,6 +172,8 @@ class Executor:
             self._thread.join(timeout=5)
         self._data_plane.close()
         self._pool.shutdown(wait=False)
+        if self._health is not None:
+            self._health.close()
 
     # -- poll loop (reference: execution_loop.rs:31-76) ----------------------
 
@@ -132,6 +194,16 @@ class Executor:
         params.metadata.host = self.config.host
         params.metadata.port = self.port
         params.metadata.num_devices = self.config.num_devices
+        # heartbeat resource gauges: the scheduler aggregates these
+        # into its own /metrics (per-executor labels)
+        g = self.resource_gauges()
+        params.metadata.resources.rss_bytes = int(g["rss_bytes"])
+        params.metadata.resources.device_bytes = int(g["device_bytes"])
+        params.metadata.resources.inflight_tasks = int(g["inflight_tasks"])
+        params.metadata.resources.ingest_pool_depth = \
+            int(g["ingest_pool_depth"])
+        params.metadata.resources.peak_host_bytes = \
+            int(g["peak_host_bytes"])
         with self._status_lock:
             for st in self._pending_status:
                 params.task_status.append(st)
@@ -155,9 +227,19 @@ class Executor:
             shuffle = (hash_exprs or None, td.shuffle_output_partitions)
 
         def work():
+            from ..observability.tracing import flow
+
+            t0 = time.time()
+            self._inflight += 1
             try:
-                with trace_span("executor.task", task=pid.key(),
-                                executor=self.id[:8]):
+                # flow(): every span/event emitted while this task runs
+                # (ingest producers included — PrefetchHandle re-binds
+                # the captured flow on its pool worker) carries the
+                # job/stage/task triple for cross-process correlation
+                with flow(job=pid.job_id, stage=pid.stage_id,
+                          task=pid.key()), \
+                        trace_span("executor.task", task=pid.key(),
+                                   executor=self.id[:8]):
                     if self.mesh_group is not None and _needs_mesh(plan):
                         # group task: broadcast so every member process
                         # enters the SPMD program together; serialized (the
@@ -170,14 +252,27 @@ class Executor:
                     else:
                         stats = self.execute_partition(pid, plan, shuffle)
                 self._report_completed(pid, stats, td.stage_version)
+                self.tasks_completed += 1
+                self._query_log.record({
+                    "task": pid.key(), "state": "completed",
+                    "wall_seconds": round(time.time() - t0, 4),
+                    "rows": int(stats.get("num_rows", 0)),
+                })
             except Exception as e:  # noqa: BLE001 - task failure
                 log.exception("task %s failed", pid)
+                self.tasks_failed += 1
+                self._query_log.record({
+                    "task": pid.key(), "state": "failed",
+                    "wall_seconds": round(time.time() - t0, 4),
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                })
                 # prefix the exception class: the scheduler retries
                 # transient (IO-shaped) failures but fails fast on
                 # deterministic ones (bad plans, overflow limits)
                 self._report_failed(pid, f"{type(e).__name__}: {e}",
                                     td.stage_version)
             finally:
+                self._inflight -= 1
                 self._slots.release()
 
         self._pool.submit(work)
@@ -331,14 +426,19 @@ class LocalCluster:
 
     def __init__(self, num_executors: int = 2, concurrent_tasks: int = 2,
                  scheduler_port: int = 0, num_devices: int = 1,
-                 speculation_age_secs: float = 60.0):
+                 speculation_age_secs: float = 60.0,
+                 metrics_port: "int | None" = None):
         from .scheduler import serve_scheduler
         from .state import MemoryBackend, SchedulerState
 
+        # metrics_port: None = off (in-process test clusters shouldn't
+        # bind sockets unasked); 0 = ephemeral health plane on the
+        # scheduler AND every executor
         self.state = SchedulerState(MemoryBackend())
         self.server, self.service, self.port = serve_scheduler(
             self.state, "localhost", scheduler_port,
             speculation_age_secs=speculation_age_secs,
+            metrics_port=metrics_port,
         )
         self.executors = []
         for _ in range(num_executors):
@@ -346,12 +446,25 @@ class LocalCluster:
                 scheduler_host="localhost", scheduler_port=self.port,
                 concurrent_tasks=concurrent_tasks,
                 num_devices=num_devices,
+                # executors always take an ephemeral port (several per
+                # host; a fixed one could only serve the first); a
+                # negative caller value means OFF here too (-1, not
+                # None — None would fall back to the env default and
+                # re-enable what the caller explicitly disabled)
+                metrics_port=(None if metrics_port is None
+                              else 0 if metrics_port >= 0 else -1),
             )
             e = Executor(cfg)
             e.start()
             self.executors.append(e)
 
+    @property
+    def scheduler_health_port(self) -> "int | None":
+        h = getattr(self.service, "health", None)
+        return h.port if h is not None else None
+
     def shutdown(self):
         for e in self.executors:
             e.stop()
+        self.service.close_health()
         self.server.stop(grace=None)

@@ -35,6 +35,7 @@ DEFAULTS = {
     # C++ shuffle-server daemon serves the data plane (GIL-free); "off"
     # keeps the in-process Python server (also the automatic fallback)
     "native_dataplane": "on",
+    "metrics_port": 0,  # health plane (/healthz, /metrics); -1 = off
     "log_level": "INFO",
 }
 
@@ -125,6 +126,7 @@ def main(argv=None) -> int:
         scheduler_port=scheduler_port,
         num_devices=num_devices,
         native_dataplane=_native_enabled(cfg["native_dataplane"]),
+        metrics_port=int(cfg["metrics_port"]),
     )
     executor = Executor(exec_cfg, mesh_group=leader)
     executor.start()
@@ -136,6 +138,9 @@ def main(argv=None) -> int:
            f"{num_devices // group_size} devices" if leader else ""),
         flush=True,
     )
+    if executor.health_port is not None:
+        print(f"ballista-tpu executor health plane on "
+              f"127.0.0.1:{executor.health_port}", flush=True)
     stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
     print(f"signal {stop}; shutting down", flush=True)
     if leader is not None:

@@ -228,7 +228,8 @@ def _job_id() -> str:
 
 class SchedulerService:
     def __init__(self, state: SchedulerState,
-                 speculation_age_secs: float = 60.0):
+                 speculation_age_secs: float = 60.0,
+                 metrics_port: "int | None" = None):
         self.state = state
         # duplicate straggler tasks older than this when executors idle;
         # 0 disables
@@ -239,6 +240,54 @@ class SchedulerService:
         from ..adaptive.replanner import replan_on_stage_complete
 
         state.replan_hook = replan_on_stage_complete
+        # health plane: /healthz + /metrics + /debug/queries. The
+        # scheduler's /metrics additionally aggregates the resource
+        # gauges executors ship with every heartbeat.
+        from ..observability.health import (maybe_start_health_server,
+                                            metrics_port_from_env)
+
+        self.tasks_dispatched = 0
+        if metrics_port is None:
+            metrics_port = metrics_port_from_env(-1)
+        self.health = maybe_start_health_server(
+            "scheduler", metrics_port, samples_fn=self._metric_samples,
+            query_log=state.query_log,
+        )
+
+    def _metric_samples(self):
+        st = self.state
+        metas = st.get_executors_metadata()
+        out = [
+            ("ballista_executors_live", {}, len(metas)),
+            ("ballista_jobs_submitted_total", {}, st.jobs_submitted),
+            ("ballista_jobs_completed_total", {}, st.jobs_completed),
+            ("ballista_jobs_failed_total", {}, st.jobs_failed),
+            ("ballista_tasks_dispatched_total", {}, self.tasks_dispatched),
+            ("ballista_ready_queue_depth", {}, st.ready_queue_depth()),
+            ("ballista_slow_queries_total", {}, st.query_log.slow_total),
+        ]
+        for m in metas:
+            # getattr: a durable backend may still hold ExecutorMeta
+            # pickles written by pre-resources code (unpickling skips
+            # dataclass defaults), and one AttributeError here would
+            # blank EVERY scheduler sample until the lease expires
+            res = getattr(m, "resources", None) or {}
+            labels = {"executor": m.id[:8]}
+            out.append(("ballista_executor_rss_bytes", labels,
+                        res.get("rss_bytes", 0)))
+            out.append(("ballista_executor_device_bytes", labels,
+                        res.get("device_bytes", 0)))
+            out.append(("ballista_executor_inflight_tasks", labels,
+                        res.get("inflight_tasks", 0)))
+            out.append(("ballista_executor_ingest_pool_depth", labels,
+                        res.get("ingest_pool_depth", 0)))
+            out.append(("ballista_executor_peak_host_bytes", labels,
+                        res.get("peak_host_bytes", 0)))
+        return out
+
+    def close_health(self):
+        if self.health is not None:
+            self.health.close()
 
     # -- RPC: ExecuteQuery --------------------------------------------------
 
@@ -340,11 +389,22 @@ class SchedulerService:
     # -- RPC: PollWork ------------------------------------------------------
 
     def PollWork(self, request: pb.PollWorkParams, context=None):
+        res = None
+        if request.metadata.HasField("resources"):
+            r = request.metadata.resources
+            res = {
+                "rss_bytes": int(r.rss_bytes),
+                "device_bytes": int(r.device_bytes),
+                "inflight_tasks": int(r.inflight_tasks),
+                "ingest_pool_depth": int(r.ingest_pool_depth),
+                "peak_host_bytes": int(r.peak_host_bytes),
+            }
         meta = ExecutorMeta(
             id=request.metadata.id,
             host=request.metadata.host,
             port=request.metadata.port,
             num_devices=request.metadata.num_devices or 1,
+            resources=res,
         )
         self.state.save_executor_metadata(meta)
         jobs_touched = set(self.state.reap_lost_tasks())
@@ -397,6 +457,7 @@ class SchedulerService:
             if task is not None:
                 try:
                     result.task.CopyFrom(self._task_definition(task, meta))
+                    self.tasks_dispatched += 1
                     trace_event("scheduler.task_dispatch", task=task.key(),
                                 executor=meta.id[:8])
                 except Exception as e:  # noqa: BLE001
@@ -605,9 +666,13 @@ _RPCS = {
 
 def serve_scheduler(state: SchedulerState, host: str = "0.0.0.0",
                     port: int = 50050, max_workers: int = 16,
-                    speculation_age_secs: float = 60.0):
-    """Start the scheduler gRPC server; returns (grpc_server, service)."""
-    svc = SchedulerService(state, speculation_age_secs=speculation_age_secs)
+                    speculation_age_secs: float = 60.0,
+                    metrics_port: "int | None" = None):
+    """Start the scheduler gRPC server; returns (grpc_server, service).
+    ``metrics_port`` starts the health plane (None = resolve
+    ``BALLISTA_METRICS_PORT``, default off; 0 = ephemeral)."""
+    svc = SchedulerService(state, speculation_age_secs=speculation_age_secs,
+                           metrics_port=metrics_port)
     handlers = {}
     for name, (req_t, _resp_t) in _RPCS.items():
         handlers[name] = grpc.unary_unary_rpc_method_handler(

@@ -23,6 +23,7 @@ DEFAULTS = {
     "etcd_urls": "localhost:2379",
     "speculation_secs": 60,  # duplicate stragglers after this; 0 = off
     "flight_port": -1,  # Arrow Flight SQL front-end; -1 = off, 0 = ephemeral
+    "metrics_port": 0,  # health plane (/healthz, /metrics); -1 = off
     "log_level": "INFO",
 }
 
@@ -64,10 +65,14 @@ def main(argv=None) -> int:
     server, _svc, port = serve_scheduler(
         state, cfg["bind_host"], cfg["port"],
         speculation_age_secs=float(cfg["speculation_secs"]),
+        metrics_port=int(cfg["metrics_port"]),
     )
     print(f"ballista-tpu scheduler listening on {cfg['bind_host']}:{port} "
           f"(backend={cfg['config_backend']}, ns={cfg['namespace']})",
           flush=True)
+    if _svc.health is not None:
+        print(f"ballista-tpu scheduler health plane on "
+              f"127.0.0.1:{_svc.health.port}", flush=True)
     flight_server = None
     if int(cfg["flight_port"]) >= 0:
         # Arrow Flight front-end: foreign clients (the reference's JDBC

@@ -203,6 +203,16 @@ class SchedulerState:
         self._speculated: set = set()
         self._spec_failed_once: set = set()
         self._last_spec_scan = 0.0
+        # health plane: ring of recent query summaries (+ slow-query
+        # log over BALLISTA_SLOW_QUERY_SECS) and job outcome counters,
+        # fed by save_job_status transitions
+        from ..observability.health import QueryLog
+
+        self.query_log = QueryLog()
+        self.jobs_submitted = 0
+        self.jobs_completed = 0
+        self.jobs_failed = 0
+        self._job_started: Dict[str, float] = {}
         self._rehydrate()
 
     def _rehydrate(self):
@@ -275,6 +285,28 @@ class SchedulerState:
 
     def save_job_status(self, job_id: str, status: JobStatus):
         self.kv.put(self._k("jobs", job_id), pickle.dumps(status))
+        # health plane bookkeeping: time the queued -> terminal window
+        # and push a summary into the query ring buffer exactly once
+        # per job (terminal states may be re-saved idempotently)
+        if status.state == "queued":
+            self.jobs_submitted += 1
+            self._job_started.setdefault(job_id, time.time())
+        elif status.state in ("completed", "failed"):
+            t0 = self._job_started.pop(job_id, None)
+            if t0 is not None:
+                if status.state == "completed":
+                    self.jobs_completed += 1
+                else:
+                    self.jobs_failed += 1
+                summary = {
+                    "job_id": job_id,
+                    "state": status.state,
+                    "wall_seconds": round(time.time() - t0, 4),
+                    "num_stages": len(self.stage_ids(job_id)),
+                }
+                if status.error:
+                    summary["error"] = str(status.error)[:300]
+                self.query_log.record(summary)
 
     def get_job_status(self, job_id: str) -> Optional[JobStatus]:
         v = self.kv.get(self._k("jobs", job_id))
@@ -508,6 +540,10 @@ class SchedulerState:
         for p in range(n):
             if p not in started and p not in queued:
                 self._ready.append(PartitionId(job_id, stage_id, p))
+
+    def ready_queue_depth(self) -> int:
+        with self._lock:
+            return len(self._ready)
 
     def next_task(self, num_devices: int = 0) -> Optional[PartitionId]:
         """Pop the first ready task the calling executor can run: a

@@ -13,6 +13,10 @@ class ExecutorMeta:
     host: str
     port: int  # data-plane port
     num_devices: int = 1
+    # last-heartbeat resource gauges (rss_bytes, device_bytes,
+    # inflight_tasks, ingest_pool_depth, peak_host_bytes) for the
+    # scheduler's health plane; None from executors predating the field
+    resources: Optional[Dict[str, int]] = None
 
 
 @dataclass(frozen=True)

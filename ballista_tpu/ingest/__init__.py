@@ -53,5 +53,6 @@ from .pipeline import (  # noqa: F401
     ingest_pool,
     iter_partitions,
     parallel_map,
+    pool_queue_depth,
     prime_plan,
 )

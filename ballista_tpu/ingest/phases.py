@@ -45,7 +45,7 @@ class PhaseRecorder:
 
     def record(self, name: str, secs: float) -> None:
         if self._metrics is not None:
-            self._metrics.add_time("elapsed_" + name, secs)
+            self._metrics.add_time("elapsed_" + name, secs)  # metric-names: elapsed_parse elapsed_h2d
 
     def add_wait(self, secs: float) -> None:
         """Time the consumer spent blocked on the prefetch queue — the

@@ -24,6 +24,7 @@ import time
 from concurrent import futures
 from typing import Callable, Dict, Iterable, List, Optional
 
+from ..observability import tracing
 from ..observability.tracing import trace_event, trace_span
 from . import phases
 from .config import ingest_threads, prefetch_batches
@@ -52,6 +53,20 @@ def _reset_pool() -> None:
         p, _pool = _pool, None
     if p is not None:
         p.shutdown(wait=False)
+
+
+def pool_queue_depth() -> int:
+    """Work items queued on the ingest pool but not yet started — the
+    backpressure gauge the executor heartbeat and health plane report.
+    0 when the pool was never created (no ingest ran yet)."""
+    with _pool_lock:
+        p = _pool
+    if p is None:
+        return 0
+    try:
+        return p._work_queue.qsize()
+    except Exception:  # noqa: BLE001 - executor internals drifted
+        return 0
 
 
 class KeyedLocks:
@@ -103,7 +118,7 @@ class PrefetchHandle:
     error semantics."""
 
     __slots__ = ("_factory", "_depth", "_q", "_closed", "_future",
-                 "_recorder", "label", "max_occupancy")
+                 "_recorder", "_flow", "label", "max_occupancy")
 
     def __init__(self, factory: Callable[[], Iterable], depth: int,
                  label: str = "", recorder=None, pool=None):
@@ -112,6 +127,10 @@ class PrefetchHandle:
         self._q: queue.Queue = queue.Queue(self._depth)
         self._closed = threading.Event()
         self._recorder = recorder
+        # flow correlation: capture the creator thread's job/stage/task
+        # attrs so producer spans on the pool worker stay attributable
+        # to the query that primed them
+        self._flow = tracing.current_flow()
         self.label = label
         # high-water mark of batches simultaneously queued (tests pin
         # it against the configured depth)
@@ -121,7 +140,8 @@ class PrefetchHandle:
     # -- producer (pool worker) ---------------------------------------------
 
     def _produce(self) -> None:
-        with trace_span("ingest.prefetch", label=self.label):
+        with tracing.flow(**self._flow), \
+                trace_span("ingest.prefetch", label=self.label):
             try:
                 with phases.bind(self._recorder):
                     for batch in self._factory():

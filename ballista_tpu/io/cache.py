@@ -19,9 +19,27 @@ class CacheSource(TableSource):
     inner scan once per racer and interleave the insert)."""
 
     def __init__(self, inner: TableSource):
+        import threading
+
         self.inner = inner
         self._cache: Dict[Tuple[int, Optional[Tuple[str, ...]]], list] = {}
         self._key_locks = KeyedLocks()
+        # cache occupancy (observability/memory): guarded by its own
+        # lock — concurrent materializations of DIFFERENT keys hold
+        # different per-key locks, so an unguarded += could lose an
+        # update and leave bytes leaked after invalidate()
+        self._size_lock = threading.Lock()
+        self._tracked_bytes = 0
+
+    @staticmethod
+    def _batches_nbytes(batches: list) -> int:
+        total = 0
+        for b in batches:
+            for c in getattr(b, "columns", []):
+                total += int(getattr(c.values, "nbytes", 0))
+                if c.validity is not None:
+                    total += int(getattr(c.validity, "nbytes", 0))
+        return total
 
     def table_schema(self) -> Schema:
         return self.inner.table_schema()
@@ -48,8 +66,14 @@ class CacheSource(TableSource):
         if key not in self._cache:  # fast path: no lock once populated
             with self._key_locks.get(key):
                 if key not in self._cache:
-                    self._cache[key] = list(self.inner.scan(partition,
-                                                            projection))
+                    batches = list(self.inner.scan(partition, projection))
+                    from ..observability import memory as obs_memory
+
+                    n = self._batches_nbytes(batches)
+                    obs_memory.record_host_bytes("cache", n)
+                    with self._size_lock:
+                        self._tracked_bytes += n
+                    self._cache[key] = batches
         yield from self._cache[key]
 
     def invalidate(self):
@@ -57,3 +81,19 @@ class CacheSource(TableSource):
         # holds one, and dropping it would let a post-invalidate scan
         # run a second concurrent inner scan against it
         self._cache.clear()
+        self._release_tracked()
+
+    def _release_tracked(self):
+        from ..observability import memory as obs_memory
+
+        with self._size_lock:
+            n, self._tracked_bytes = self._tracked_bytes, 0
+        obs_memory.release_host_bytes("cache", n)
+
+    def __del__(self):
+        # a CacheSource dropped without invalidate() must not leak its
+        # bytes in the accounting gauges
+        try:
+            self._release_tracked()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass

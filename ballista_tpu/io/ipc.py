@@ -278,14 +278,20 @@ def unify_dictionaries(
 ) -> Tuple[Dictionary, List[np.ndarray]]:
     """[(codes, dict_values)] from several producers -> (union Dictionary,
     remapped codes per part). Sorted union keeps codes ordinal."""
-    union = np.unique(np.concatenate([d for _, d in parts])) if parts else np.asarray([], object)
-    out_dict = Dictionary(union)
-    remapped = []
-    union_str = union.astype(str)
-    for codes, dvals in parts:
-        remap = np.searchsorted(union_str, np.asarray(dvals).astype(str))
-        remapped.append(remap[codes].astype(np.int32) if len(dvals) else codes)
-    return out_dict, remapped
+    from ..observability.tracing import trace_span
+
+    with trace_span("host.dictionary", site="ipc.unify", n_parts=len(parts)):
+        union = np.unique(np.concatenate([d for _, d in parts])) \
+            if parts else np.asarray([], object)
+        out_dict = Dictionary(union)
+        remapped = []
+        union_str = union.astype(str)
+        for codes, dvals in parts:
+            remap = np.searchsorted(union_str,
+                                    np.asarray(dvals).astype(str))
+            remapped.append(remap[codes].astype(np.int32)
+                            if len(dvals) else codes)
+        return out_dict, remapped
 
 
 def batches_from_parts(
@@ -298,9 +304,23 @@ def batches_from_parts(
     (arrays, nulls, dicts per part), unioning utf8 dictionaries."""
     import jax.numpy as jnp
 
+    from ..observability.memory import track_host_bytes
+
     if not parts:
         return []
-    # union dictionaries per utf8 column
+    # shuffle-read host buffers: transient, but the peak matters — the
+    # memory plane attributes them separately from scan parse buffers
+    shuffle_bytes = sum(
+        int(getattr(a, "nbytes", 0))
+        for arrays, _nulls, _dicts in parts for a in arrays.values()
+    )
+    with track_host_bytes("shuffle", shuffle_bytes):
+        return _batches_from_parts_inner(schema, parts, capacity, jnp)
+
+
+def _batches_from_parts_inner(schema, parts, capacity, jnp):
+    # union dictionaries per utf8 column — split from batches_from_parts
+    # only so the shuffle-byte accounting brackets the whole assembly
     union_dicts: Dict[str, Dictionary] = {}
     remaps: Dict[str, List[np.ndarray]] = {}
     for f in schema.fields:

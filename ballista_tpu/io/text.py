@@ -368,6 +368,19 @@ class DelimitedSource(TableSource):
         """``force_emit`` guarantees at least one (possibly empty) batch;
         streaming callers emit per range and handle the empty-table case
         themselves."""
+        from ..observability.memory import track_host_bytes
+
+        # parse buffers live on host until every chunk uploaded: account
+        # them under "batches" for the peak-memory breakdown (the with
+        # releases on generator close too — abandoned scans included)
+        parse_bytes = sum(int(getattr(a, "nbytes", 0))
+                          for a in arrays.values())
+        with track_host_bytes("batches", parse_bytes):
+            yield from self._emit_batches_inner(sub_schema, n, arrays,
+                                                dicts, valids, force_emit)
+
+    def _emit_batches_inner(self, sub_schema, n, arrays, dicts,
+                            valids=None, force_emit=True):
         # scan batches enter at canonical ladder capacities so uneven
         # files/partitions reuse a handful of compiled signatures
         cap = min(self._capacity, bucket_capacity(max(n, 1)))

@@ -1,0 +1,191 @@
+"""Profile artifact export: Chrome-trace/Perfetto JSON + lane summary.
+
+One artifact file serves two readers:
+
+- **chrome://tracing / Perfetto** load it directly: the top-level
+  object carries a ``traceEvents`` array (Complete ``"X"`` / Instant
+  ``"i"`` / Metadata ``"M"`` events, microsecond timestamps) and both
+  tools ignore the extra keys.
+- **Programs / humans** read the summary keys: ``wall_seconds``,
+  ``lanes`` (the named wall-time decomposition), ``phases``,
+  ``compile``, ``memory``, ``operators``.
+
+Lane semantics (``lanes`` + ``lane_fractions``): measured categories
+are THREAD seconds summed from their spans — under the ingest pipeline
+they overlap, so their sum may legitimately exceed wall time —
+
+- ``parse`` / ``h2d``: ingest phase totals (file parse, host->device);
+- ``compile_trace_lower``: governed first-call time (jaxpr trace +
+  lowering + backend compile or persistent-cache retrieval) from
+  ``compile.jit`` records;
+- ``device_blocked``: host time blocked on device results
+  (``device.block`` spans: batched count syncs, result fetches, join
+  builds);
+- ``host_dictionary``: host-side numpy dictionary work
+  (``host.dictionary`` spans: unify/remap/union builds);
+- ``xla_execute_other``: the remainder of the wall clock after the
+  measured categories (clamped at 0) — on this engine dominated by XLA
+  execution and dispatch, hence the name.
+
+``attributed_fraction`` is the fraction of wall time covered by the
+MEASURED lanes (the remainder lane deliberately excluded — including a
+lane defined as "whatever is left" would make the metric identically
+1.0 and meaningless). 1.0 means every wall second was inside an
+instrumented category; a low value means the ``xla_execute_other``
+remainder carries most of the attribution and should be read as "XLA
+execute + uninstrumented host work". Overlapped thread-seconds beyond
+the wall clock don't raise it past 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+LANE_NAMES = ("parse", "h2d", "compile_trace_lower", "device_blocked",
+              "host_dictionary", "xla_execute_other")
+
+
+def compute_lanes(session: dict) -> dict:
+    """The named wall-time decomposition (see module docstring)."""
+    wall = float(session.get("wall_seconds", 0.0))
+    phases = session.get("phases") or {}
+    records = session.get("records") or []
+
+    def span_sum(name: str, field: str = "dur") -> float:
+        return float(sum(float(r.get(field, 0.0)) for r in records
+                         if r.get("name") == name))
+
+    lanes = {
+        "parse": round(float(phases.get("parse", 0.0)), 6),
+        "h2d": round(float(phases.get("h2d", 0.0)), 6),
+        "device_blocked": round(span_sum("device.block"), 6),
+        "host_dictionary": round(span_sum("host.dictionary"), 6),
+    }
+    compile_lane = sum(float(r.get("call_seconds", 0.0)) for r in records
+                       if r.get("name") == "compile.jit")
+    if compile_lane == 0.0:
+        # no compile.jit records (tracing came up late): fall back to
+        # the governor's process-stat delta
+        comp = session.get("compile") or {}
+        compile_lane = (float(comp.get("compile_seconds", 0.0))
+                        + float(comp.get("trace_seconds", 0.0)))
+    lanes["compile_trace_lower"] = round(compile_lane, 6)
+    measured = sum(lanes.values())
+    lanes["xla_execute_other"] = round(max(0.0, wall - measured), 6)
+    out = {
+        "lanes": lanes,
+        "measured_seconds": round(measured, 6),
+        "attributed_fraction": (round(min(1.0, measured / wall), 4)
+                                if wall > 0 else 0.0),
+    }
+    if wall > 0:
+        out["lane_fractions"] = {
+            k: round(v / wall, 4) for k, v in lanes.items()
+        }
+    return out
+
+
+def _thread_names(records: List[dict], main_tid: int) -> Dict[tuple, str]:
+    """(pid, tid) -> display name: ingest producer threads get their
+    own labels (their spans are what makes the overlap visible)."""
+    names: Dict[tuple, str] = {}
+    producer_n: Dict[int, int] = {}
+    for r in records:
+        key = (r.get("pid", 0), r.get("tid", 0))
+        if key in names:
+            continue
+        if r.get("name", "").startswith("ingest.") and \
+                r.get("tid") != main_tid:
+            n = producer_n.get(r.get("pid", 0), 0)
+            producer_n[r.get("pid", 0)] = n + 1
+            names[key] = f"ingest-producer-{n}"
+    for r in records:
+        key = (r.get("pid", 0), r.get("tid", 0))
+        if key not in names:
+            names[key] = "main" if r.get("tid") == main_tid \
+                else f"worker-{len(names)}"
+    return names
+
+
+_META_KEYS = ("name", "ts", "dur", "pid", "tid")
+
+
+def to_chrome_trace(session: dict, main_tid: Optional[int] = None) -> list:
+    """Session records -> Chrome trace event array."""
+    records = session.get("records") or []
+    t0 = float(session.get("t0", 0.0))
+    if main_tid is None:
+        main_tid = threading.get_ident()
+    events: List[dict] = []
+    seen_pids = set()
+    for key, tname in _thread_names(records, main_tid).items():
+        pid, tid = key
+        if pid not in seen_pids:
+            seen_pids.add(pid)
+            events.append({"ph": "M", "name": "process_name", "pid": pid,
+                           "tid": 0,
+                           "args": {"name": f"ballista pid {pid}"}})
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tid, "args": {"name": tname}})
+    for r in records:
+        args = {k: v for k, v in r.items() if k not in _META_KEYS}
+        ev = {
+            "name": r.get("name", "?"),
+            "cat": str(r.get("name", "?")).split(".")[0],
+            "pid": r.get("pid", 0),
+            "tid": r.get("tid", 0),
+            "ts": round((float(r.get("ts", t0)) - t0) * 1e6, 1),
+            "args": args,
+        }
+        if "dur" in r:
+            ev["ph"] = "X"
+            ev["dur"] = round(float(r["dur"]) * 1e6, 1)
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        events.append(ev)
+    events.sort(key=lambda e: (e["ph"] != "M", e.get("ts", 0.0)))
+    return events
+
+
+def build_artifact(session: dict) -> dict:
+    """Merge a profiler session into the final artifact dict."""
+    art = {
+        "schema": session.get("schema", "ballista-profile-v1"),
+        "label": session.get("label", "query"),
+        "wall_seconds": session.get("wall_seconds", 0.0),
+        "phases": session.get("phases", {}),
+        "compile": session.get("compile", {}),
+        "memory": session.get("memory", {}),
+        "operators": session.get("operators"),
+        "displayTimeUnit": "ms",
+        "traceEvents": to_chrome_trace(session),
+    }
+    art.update(compute_lanes(session))
+    art["otherData"] = {
+        "label": art["label"],
+        "wall_seconds": art["wall_seconds"],
+        "attributed_fraction": art["attributed_fraction"],
+    }
+    return art
+
+
+def write_artifact(session: dict, out_dir: Optional[str] = None,
+                   out_path: Optional[str] = None) -> str:
+    """Write the artifact JSON; returns its path. ``out_path`` pins the
+    exact file, otherwise a timestamped name lands in ``out_dir``
+    (default: cwd)."""
+    art = build_artifact(session)
+    if out_path is None:
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in str(art["label"]))[:48] or "query"
+        fname = f"ballista-profile-{safe}-{int(time.time() * 1000)}.json"
+        out_path = os.path.join(out_dir or os.getcwd(), fname)
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, "w") as fh:
+        json.dump(art, fh, default=str)
+    return out_path

@@ -1,0 +1,248 @@
+"""Live cluster health plane: ``/healthz``, ``/metrics``,
+``/debug/queries``.
+
+A lightweight stdlib HTTP server every long-running process (scheduler,
+executor) can start next to its RPC port — no new dependencies, daemon
+threads only, one instance per process role:
+
+- ``GET /healthz`` — liveness: ``200 {"status": "ok", ...}`` with role,
+  pid, uptime. Cluster tests poll this instead of sleeping.
+- ``GET /metrics`` — Prometheus text exposition. Families come from the
+  process's registered sample callbacks; names MUST exist in
+  ``registry.PROCESS_METRICS`` (the renderer drops unknown names — the
+  registry is the contract ``dev/check_metric_names.py`` lints).
+- ``GET /debug/queries`` — JSON ring buffer of recent query summaries
+  plus the slow-query subset (``BALLISTA_SLOW_QUERY_SECS``).
+
+Servers bind ``127.0.0.1`` by default (diagnosis plane, not a public
+API); ``port=0`` picks an ephemeral port (read ``server.port``)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .registry import PROCESS_METRICS
+
+log = logging.getLogger("ballista.health")
+
+# sample: (family name, labels dict, numeric value)
+Sample = Tuple[str, Dict[str, str], float]
+
+
+def slow_query_secs() -> Optional[float]:
+    """BALLISTA_SLOW_QUERY_SECS threshold, or None when unset/invalid."""
+    v = os.environ.get("BALLISTA_SLOW_QUERY_SECS", "")
+    if not v:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        return None
+
+
+class QueryLog:
+    """Bounded ring of recent query summaries + the slow subset.
+
+    ``record`` takes a summary dict (job_id/label, wall_seconds,
+    state, ...); entries over the slow threshold are ALSO kept in a
+    separate ring so a burst of fast queries can't evict the slow one
+    being investigated. Thread-safe, lock-cheap."""
+
+    def __init__(self, capacity: int = 128):
+        self._lock = threading.Lock()
+        self._recent: deque = deque(maxlen=capacity)
+        self._slow: deque = deque(maxlen=capacity)
+        self.slow_total = 0
+
+    def record(self, summary: dict) -> None:
+        entry = dict(summary)
+        entry.setdefault("recorded_at", time.time())
+        thr = slow_query_secs()
+        is_slow = (thr is not None
+                   and float(entry.get("wall_seconds", 0.0)) >= thr)
+        with self._lock:
+            self._recent.append(entry)
+            if is_slow:
+                self._slow.append(entry)
+                self.slow_total += 1
+        if is_slow:
+            log.warning("slow query (>= %.3fs): %s", thr,
+                        json.dumps(entry, default=str))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "queries": list(self._recent),
+                "slow_queries": list(self._slow),
+                "slow_query_secs": slow_query_secs(),
+                "slow_total": self.slow_total,
+            }
+
+
+def render_prometheus(samples: List[Sample]) -> str:
+    """Prometheus text exposition (v0.0.4). Families are grouped, HELP/
+    TYPE come from the registry; samples whose family the registry
+    doesn't know are dropped (loudly, once per name)."""
+    by_family: Dict[str, List[Sample]] = {}
+    for name, labels, value in samples:
+        if name not in PROCESS_METRICS:
+            log.warning("dropping unregistered metric family %r "
+                        "(add it to observability/registry.py)", name)
+            continue
+        by_family.setdefault(name, []).append((name, labels, value))
+    lines: List[str] = []
+    for name in sorted(by_family):
+        kind, help_text = PROCESS_METRICS[name]
+        ptype = "counter" if kind == "counter" else "gauge"
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {ptype}")
+        for _, labels, value in by_family[name]:
+            label_s = ""
+            if labels:
+                inner = ",".join(
+                    '{}="{}"'.format(
+                        k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+                    for k, v in sorted(labels.items())
+                )
+                label_s = "{" + inner + "}"
+            if float(value) == int(value):
+                vs = str(int(value))
+            else:
+                vs = repr(float(value))
+            lines.append(f"{name}{label_s} {vs}")
+    return "\n".join(lines) + "\n"
+
+
+def base_process_samples() -> List[Sample]:
+    """Samples every role exports: RSS, tracked host bytes (+ per
+    category), device bytes."""
+    from . import memory as obs_memory
+
+    snap = obs_memory.memory_snapshot()
+    out: List[Sample] = [
+        ("ballista_rss_bytes", {}, snap["rss_bytes"]),
+        ("ballista_host_tracked_bytes", {}, snap["current_bytes"]),
+        ("ballista_host_tracked_peak_bytes", {}, snap["peak_bytes"]),
+        ("ballista_device_bytes", {}, snap["device_bytes"]),
+        ("ballista_device_peak_bytes", {}, snap["peak_device_bytes"]),
+    ]
+    for cat, n in sorted(snap["by_category"].items()):
+        out.append(("ballista_host_category_bytes", {"category": cat}, n))
+    return out
+
+
+class HealthServer:
+    """The per-process health plane. ``samples_fn`` returns the role's
+    metric samples (base process samples are appended automatically);
+    ``query_log`` feeds ``/debug/queries``."""
+
+    def __init__(self, role: str, port: int = 0,
+                 samples_fn: Optional[Callable[[], List[Sample]]] = None,
+                 query_log: Optional[QueryLog] = None,
+                 host: str = "127.0.0.1"):
+        self.role = role
+        self.query_log = query_log or QueryLog()
+        self._samples_fn = samples_fn
+        self._started_at = time.time()
+        plane = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silent: no stdout spam
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    path = self.path.split("?", 1)[0]
+                    if path == "/healthz":
+                        body = json.dumps(plane.healthz()).encode()
+                        self._send(200, body, "application/json")
+                    elif path == "/metrics":
+                        body = plane.metrics_text().encode()
+                        self._send(200, body,
+                                   "text/plain; version=0.0.4")
+                    elif path == "/debug/queries":
+                        body = json.dumps(plane.query_log.snapshot(),
+                                          default=str).encode()
+                        self._send(200, body, "application/json")
+                    else:
+                        self._send(404, b"not found", "text/plain")
+                except Exception:  # noqa: BLE001 - never kill the plane
+                    try:
+                        self._send(500, b"internal error", "text/plain")
+                    except Exception:  # noqa: BLE001 - peer went away
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"health-{role}-{self.port}",
+        )
+        self._thread.start()
+
+    def healthz(self) -> dict:
+        return {
+            "status": "ok",
+            "role": self.role,
+            "pid": os.getpid(),
+            "uptime_seconds": round(time.time() - self._started_at, 3),
+        }
+
+    def metrics_text(self) -> str:
+        samples: List[Sample] = [
+            ("ballista_up", {}, 1),
+            ("ballista_uptime_seconds", {},
+             time.time() - self._started_at),
+        ]
+        if self._samples_fn is not None:
+            try:
+                samples.extend(self._samples_fn())
+            except Exception:  # noqa: BLE001 - plane must stay up
+                log.exception("metrics sample callback failed")
+        samples.extend(base_process_samples())
+        return render_prometheus(samples)
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:  # noqa: BLE001 - already down
+            pass
+
+
+def metrics_port_from_env(default: int = -1) -> int:
+    """BALLISTA_METRICS_PORT: -1 = off, 0 = ephemeral, else fixed."""
+    try:
+        return int(os.environ.get("BALLISTA_METRICS_PORT", str(default)))
+    except ValueError:
+        return default
+
+
+def maybe_start_health_server(role: str, port: Optional[int],
+                              samples_fn=None, query_log=None
+                              ) -> Optional[HealthServer]:
+    """Start a health server unless disabled (``port`` None/negative)."""
+    if port is None or port < 0:
+        return None
+    try:
+        return HealthServer(role, port, samples_fn=samples_fn,
+                            query_log=query_log)
+    except OSError as e:
+        log.warning("health plane for %s failed to bind port %s: %s",
+                    role, port, e)
+        return None

@@ -188,9 +188,13 @@ def instrument_execute(fn):
         if not metrics_enabled():
             yield from fn(self, partition)
             return
+        from . import memory as obs_memory
+
         m = self.metrics()
         it = fn(self, partition)
         perf = time.perf_counter
+        host_peak = obs_memory.current_host_bytes
+        dev_peak = obs_memory.device_bytes
         acc = 0.0
         try:
             while True:
@@ -202,6 +206,16 @@ def instrument_execute(fn):
                     return
                 acc += perf() - t0
                 m.record_output_batch(batch)
+                # monotone per-operator memory high-water marks: cheap
+                # reads of the process trackers (device sampling is
+                # rate-limited inside device_bytes)
+                g = m._gauges
+                hb = host_peak()
+                if hb > g.get("peak_host_bytes", 0.0):
+                    g["peak_host_bytes"] = float(hb)
+                db = dev_peak()
+                if db > g.get("peak_device_bytes", 0.0):
+                    g["peak_device_bytes"] = float(db)
                 yield batch
         finally:
             # finally (not loop exit): a consumer abandoning the stream

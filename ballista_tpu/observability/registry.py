@@ -1,0 +1,112 @@
+"""Metric name registry: the single source of truth for metric names.
+
+Two sections:
+
+- :data:`OPERATOR_METRICS` — names recorded on per-operator
+  ``MetricsSet`` instances (``add_counter`` / ``add_time`` /
+  ``set_gauge``). ``dev/check_metric_names.py`` lints every literal
+  call site in the package against this table, so a typo'd or
+  undocumented metric name fails tier-1 instead of silently forking the
+  namespace.
+- :data:`PROCESS_METRICS` — Prometheus families the health plane
+  exports (``observability/health.py`` renders ``# HELP``/``# TYPE``
+  lines from here and refuses to export a family this table doesn't
+  know).
+
+Kinds: ``counter`` (monotonic int, summed on merge), ``timer``
+(``elapsed_*`` seconds, summed on merge), ``gauge`` (last/max value,
+max-ed on merge).
+"""
+
+from __future__ import annotations
+
+# -- per-operator MetricsSet names -------------------------------------------
+
+OPERATOR_METRICS = {
+    # recorded automatically by instrument_execute
+    "output_rows": ("counter", "live rows yielded (device counts, lazy)"),
+    "output_batches": ("counter", "batches yielded"),
+    "elapsed_compute": ("timer", "cumulative wall time inside the "
+                                 "operator's generator, children included"),
+    "elapsed_self": ("timer", "derived: elapsed_compute minus children"),
+    "peak_host_bytes": ("gauge", "peak tracked host bytes observed while "
+                                 "this operator yielded"),
+    "peak_device_bytes": ("gauge", "peak device bytes observed while this "
+                                   "operator yielded"),
+    # compile governor attribution
+    "compile_count": ("counter", "XLA backend compiles attributed to the "
+                                 "operator's governed calls"),
+    "elapsed_compile": ("timer", "first-call compile (+first batch) time"),
+    "persistent_cache_hits": ("counter", "disk-cache hits that skipped a "
+                                         "compile"),
+    # ingest phases
+    "elapsed_parse": ("timer", "file -> host arrays parse time"),
+    "elapsed_h2d": ("timer", "host -> device transfer time"),
+    "elapsed_prefetch_wait": ("timer", "consumer time blocked on the "
+                                       "prefetch queue"),
+    "prefetched_batches": ("counter", "batches served through the "
+                                      "prefetch queue"),
+    # operator-specific
+    "compact_count": ("counter", "adaptive post-filter compactions taken"),
+    "expand_reruns": ("counter", "expanding-probe capacity re-runs"),
+    "bytes_read": ("counter", "shuffle reader input bytes"),
+    "local_reads": ("counter", "shuffle partitions read from local disk"),
+    "remote_fetches": ("counter", "shuffle partitions fetched over the "
+                                  "data plane"),
+    "bytes_written": ("counter", "partition/shuffle output bytes"),
+    "elapsed_write": ("timer", "partition IPC write time"),
+    "selectivity": ("gauge", "filter pass fraction"),
+}
+
+# -- Prometheus families exported by the health plane ------------------------
+
+PROCESS_METRICS = {
+    "ballista_up": ("gauge", "1 while the process serves its health plane"),
+    "ballista_uptime_seconds": ("gauge", "seconds since process start"),
+    "ballista_rss_bytes": ("gauge", "resident set size of the process"),
+    "ballista_host_tracked_bytes": ("gauge", "host bytes currently tracked "
+                                            "by category accounting"),
+    "ballista_host_tracked_peak_bytes": ("gauge", "peak tracked host bytes"),
+    "ballista_host_category_bytes": ("gauge", "tracked host bytes by "
+                                              "category label"),
+    "ballista_device_bytes": ("gauge", "device bytes in use (live arrays / "
+                                       "allocator stats)"),
+    "ballista_device_peak_bytes": ("gauge", "peak observed device bytes"),
+    # executor
+    "ballista_inflight_tasks": ("gauge", "tasks currently executing"),
+    "ballista_ingest_pool_depth": ("gauge", "queued work items waiting on "
+                                            "the ingest pool"),
+    "ballista_tasks_completed_total": ("counter", "tasks completed"),
+    "ballista_tasks_failed_total": ("counter", "tasks failed"),
+    # scheduler
+    "ballista_executors_live": ("gauge", "executors with an unexpired "
+                                         "lease"),
+    "ballista_jobs_submitted_total": ("counter", "jobs accepted by "
+                                                 "ExecuteQuery"),
+    "ballista_jobs_completed_total": ("counter", "jobs completed"),
+    "ballista_jobs_failed_total": ("counter", "jobs failed"),
+    "ballista_tasks_dispatched_total": ("counter", "task definitions "
+                                                   "handed to executors"),
+    "ballista_ready_queue_depth": ("gauge", "tasks in the ready queue"),
+    "ballista_slow_queries_total": ("counter", "completed queries over "
+                                               "BALLISTA_SLOW_QUERY_SECS"),
+    # scheduler-side aggregation of executor heartbeat gauges
+    "ballista_executor_rss_bytes": ("gauge", "per-executor RSS from the "
+                                             "last heartbeat"),
+    "ballista_executor_device_bytes": ("gauge", "per-executor device bytes "
+                                                "from the last heartbeat"),
+    "ballista_executor_inflight_tasks": ("gauge", "per-executor inflight "
+                                                  "tasks"),
+    "ballista_executor_ingest_pool_depth": ("gauge", "per-executor ingest "
+                                                     "pool queue depth"),
+    "ballista_executor_peak_host_bytes": ("gauge", "per-executor peak "
+                                                   "tracked host bytes"),
+}
+
+
+def operator_metric_names() -> set:
+    return set(OPERATOR_METRICS)
+
+
+def process_metric_names() -> set:
+    return set(PROCESS_METRICS)

@@ -4,31 +4,53 @@ process.
 Coverage (each site tags its span name with the subsystem): scheduler
 events (``scheduler.plan_job``, ``scheduler.task_dispatch``), executor
 task execution (``executor.task``), shuffle fetch (``shuffle.fetch``),
-and dataplane I/O (``dataplane.write``). A span line is::
+dataplane I/O (``dataplane.write``), ingest phases (``ingest.*``),
+compile activity (``compile.jit``), host dictionary work
+(``host.dictionary``) and blocking device syncs (``device.block``).
+A span line is::
 
     {"name": ..., "ts": <epoch start>, "dur": <seconds>, "pid": ...,
-     "tid": ..., <attrs>}
+     "tid": ..., "sid": <span id>, "psid": <parent span id>, <attrs>}
 
-Instant events carry no ``dur``. Files land in ``BALLISTA_TRACE_DIR``
-(default: the system temp dir) as ``ballista-trace-<pid>.jsonl`` so a
-multi-process cluster writes one file per scheduler/executor process
-with no cross-process locking; ``BALLISTA_TRACE_FILE`` pins an exact
-path instead. Writes are line-buffered under a process-local lock —
-tracing is for diagnosis runs, not the steady-state hot path, and the
-disabled path is a single cached boolean check.
+Instant events carry no ``dur``/``sid`` (only the enclosing ``psid``).
+``sid``/``psid`` are process-local monotonic ids kept on a per-thread
+span stack, so the profiler (``observability/profiler.py``) can rebuild
+the call tree instead of guessing from timestamps. Cross-process /
+cross-thread flow correlation is STRUCTURAL: :func:`flow` binds
+``job``/``stage``/``task`` attributes on the current thread, every
+record emitted under it inherits them (explicit span attrs win), and
+:func:`current_flow` lets pool handoffs (ingest producers) re-bind the
+creator's flow on the worker thread.
+
+Files land in ``BALLISTA_TRACE_DIR`` (default: the system temp dir) as
+``ballista-trace-<pid>.jsonl`` so a multi-process cluster writes one
+file per scheduler/executor process with no cross-process locking;
+``BALLISTA_TRACE_FILE`` pins an exact path instead. Hygiene knobs:
+``BALLISTA_TRACE_TRUNCATE=1`` opens the file fresh instead of appending
+(long benchmark loops otherwise grow one file forever), and
+``BALLISTA_TRACE_MAX_MB=<n>`` caps the file — once the cap is reached a
+single ``trace.capped`` marker is written and further records are
+dropped (never raising into the traced code). Writes are line-buffered
+under a process-local lock — tracing is for diagnosis runs, not the
+steady-state hot path, and the disabled path is a single cached boolean
+check.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import tempfile
 import threading
 import time
+from contextlib import contextmanager
 from typing import Optional
 
 _lock = threading.Lock()
 _state: dict = {"configured": False, "fh": None}
+_span_ids = itertools.count(1)
+_tls = threading.local()
 
 
 def _configure_locked() -> None:
@@ -47,9 +69,21 @@ def _configure_locked() -> None:
         trace_dir = os.environ.get("BALLISTA_TRACE_DIR",
                                    tempfile.gettempdir())
         path = os.path.join(trace_dir, f"ballista-trace-{os.getpid()}.jsonl")
+    truncate = os.environ.get("BALLISTA_TRACE_TRUNCATE", "").lower() in (
+        "1", "on", "true")
+    try:
+        _state["max_bytes"] = int(
+            float(os.environ.get("BALLISTA_TRACE_MAX_MB", "0")) * 1e6)
+    except ValueError:
+        _state["max_bytes"] = 0
     try:
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        _state["fh"] = open(path, "a", buffering=1)
+        mode = "w" if truncate else "a"
+        # the size cap covers the WHOLE file, appended history included
+        _state["bytes"] = (os.path.getsize(path)
+                           if not truncate and os.path.exists(path) else 0)
+        _state["capped"] = False
+        _state["fh"] = open(path, mode, buffering=1)
         _state["path"] = path
     except OSError:
         _state["fh"] = None
@@ -86,47 +120,121 @@ def reconfigure() -> None:
         _state.update({"configured": False, "fh": None})
 
 
+# -- flow correlation ---------------------------------------------------------
+
+
+def current_flow() -> dict:
+    """The flow attributes bound on this thread (``{}`` when none).
+    Pool handoffs capture this at submit time and re-bind it on the
+    worker via :func:`flow` so producer spans stay correlated with the
+    query/task that spawned them."""
+    return dict(getattr(_tls, "flow", None) or {})
+
+
+@contextmanager
+def flow(**attrs):
+    """Bind flow-correlation attributes (``job=...``, ``stage=...``,
+    ``task=...``) on the current thread: every span/event emitted inside
+    inherits them. Nested flows layer (inner keys win)."""
+    prev = getattr(_tls, "flow", None)
+    merged = dict(prev or {})
+    merged.update({k: v for k, v in attrs.items() if v is not None})
+    _tls.flow = merged
+    try:
+        yield
+    finally:
+        _tls.flow = prev
+
+
+def _span_stack() -> list:
+    st = getattr(_tls, "spans", None)
+    if st is None:
+        st = _tls.spans = []
+    return st
+
+
 def _emit(record: dict) -> None:
     fh = _fh()
     if fh is None:
         return
     line = json.dumps(record, default=str)
     with _lock:
+        if _state.get("capped"):
+            return
+        cap = _state.get("max_bytes") or 0
+        if cap and _state.get("bytes", 0) + len(line) + 1 > cap:
+            _state["capped"] = True
+            marker = json.dumps({"name": "trace.capped",
+                                 "ts": time.time(), "pid": os.getpid(),
+                                 "max_mb": cap / 1e6})
+            try:
+                fh.write(marker + "\n")
+            except (OSError, ValueError):
+                pass
+            return
         try:
             fh.write(line + "\n")
+            _state["bytes"] = _state.get("bytes", 0) + len(line) + 1
         except (OSError, ValueError):  # closed/full: drop, never raise
             pass
 
 
+def _base_record(name: str, attrs: dict) -> dict:
+    rec = {"name": name, "ts": time.time(),
+           "pid": os.getpid(), "tid": threading.get_ident()}
+    fl = getattr(_tls, "flow", None)
+    if fl:
+        rec.update(fl)
+    rec.update(attrs)
+    return rec
+
+
 def trace_event(name: str, **attrs) -> None:
-    """Instant event (no duration)."""
+    """Instant event (no duration). Carries the enclosing span's id as
+    ``psid`` so it nests in the reconstructed tree."""
     if _fh() is None:
         return
-    _emit({"name": name, "ts": time.time(),
-           "pid": os.getpid(), "tid": threading.get_ident(), **attrs})
+    rec = _base_record(name, attrs)
+    st = _span_stack()
+    if st:
+        rec["psid"] = st[-1]
+    _emit(rec)
 
 
 class trace_span:
     """``with trace_span("executor.task", task=key): ...`` — records one
     line with the span's start time and duration (exceptions are noted
-    as ``error=<ExcType>`` and re-raised)."""
+    as ``error=<ExcType>`` and re-raised). Each span gets a process-
+    local ``sid`` and its enclosing span's ``psid``."""
 
-    __slots__ = ("name", "attrs", "_t0")
+    __slots__ = ("name", "attrs", "_t0", "_sid", "_psid")
 
     def __init__(self, name: str, **attrs):
         self.name = name
         self.attrs = attrs
 
     def __enter__(self):
-        self._t0 = time.time() if _fh() is not None else None
+        if _fh() is None:
+            self._t0 = None
+            return self
+        self._t0 = time.time()
+        st = _span_stack()
+        self._psid = st[-1] if st else None
+        self._sid = next(_span_ids)
+        st.append(self._sid)
         return self
 
     def __exit__(self, exc_type, exc, tb):
         if self._t0 is not None:
-            rec = {"name": self.name, "ts": self._t0,
-                   "dur": time.time() - self._t0,
-                   "pid": os.getpid(), "tid": threading.get_ident(),
-                   **self.attrs}
+            st = _span_stack()
+            if st and st[-1] == self._sid:
+                st.pop()
+            rec = _base_record(self.name, self.attrs)
+            rec["ts"] = self._t0
+            rec["dur"] = time.time() - self._t0
+            rec["sid"] = self._sid
+            if self._psid is not None:
+                rec["psid"] = self._psid
             if exc_type is not None:
                 rec["error"] = exc_type.__name__
             _emit(rec)

@@ -387,7 +387,14 @@ class HashAggregateExec(PhysicalPlan):
             return stats
 
         fn = self.governed_jit(("agg.mstats", tuple(layout)), build)
-        mm, nlive = jax.device_get(fn(batch))
+        from ..observability import trace_span
+
+        # launch OUTSIDE the span: a cold call compiles synchronously
+        # and the governor already attributes that to the compile lane —
+        # only the blocking fetch is device-blocked time
+        res = fn(batch)
+        with trace_span("device.block", site="agg.mstats"):
+            mm, nlive = jax.device_get(res)
         return [(int(lo), int(hi)) for lo, hi in mm], int(nlive)
 
     def _exec_grouped(self, batch: ColumnBatch) -> ColumnBatch:

@@ -331,23 +331,27 @@ def concat_batches(schema: Schema, batches: List[ColumnBatch]) -> ColumnBatch:
             d is not None and d is not dict_ for d in dicts
         ):
             # unify: sorted union + per-batch code remap
-            union = np.unique(np.concatenate(
-                [np.asarray(d.values, dtype=object) for d in dicts
-                 if d is not None]
-            ))
-            union_str = union.astype(str)
-            dict_ = Dictionary(union)
-            remapped = []
-            for d, v in zip(dicts, values_list):
-                if d is None or len(d) == 0:
-                    remapped.append(v)
-                    continue
-                remap = np.searchsorted(union_str, d.values.astype(str))
-                remapped.append(
-                    jnp.take(jnp.asarray(remap.astype(np.int32)),
-                             v.astype(jnp.int32), mode="clip")
-                )
-            values_list = remapped
+            from ..observability import trace_span
+
+            with trace_span("host.dictionary", site="concat.unify",
+                            column=f.name, n_dicts=len(dicts)):
+                union = np.unique(np.concatenate(
+                    [np.asarray(d.values, dtype=object) for d in dicts
+                     if d is not None]
+                ))
+                union_str = union.astype(str)
+                dict_ = Dictionary(union)
+                remapped = []
+                for d, v in zip(dicts, values_list):
+                    if d is None or len(d) == 0:
+                        remapped.append(v)
+                        continue
+                    remap = np.searchsorted(union_str, d.values.astype(str))
+                    remapped.append(
+                        jnp.take(jnp.asarray(remap.astype(np.int32)),
+                                 v.astype(jnp.int32), mode="clip")
+                    )
+                values_list = remapped
         vals = jnp.concatenate(values_list)
         vs = [b.columns[i].validity for b in batches]
         if any(v is not None for v in vs):

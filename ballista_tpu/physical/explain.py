@@ -137,6 +137,19 @@ class ExplainAnalyzeExec(PhysicalPlan):
             rows.append(("logical_plan", self.logical_text))
         rows.append(("plan_with_metrics", self.inner.pretty_metrics()))
         rows.append(("total_elapsed", f"{total:.6f}s"))
+        # memory plane summary: process peaks + host bytes by category
+        # (operator-level peak_host_bytes/peak_device_bytes gauges ride
+        # the plan annotation above)
+        from ..observability import memory as obs_memory
+
+        snap = obs_memory.memory_snapshot()
+        cats = ", ".join(f"{k}={v}" for k, v in
+                         sorted(snap["by_category"].items()) if v)
+        rows.append(("memory",
+                     f"peak_host_bytes={snap['peak_bytes']}, "
+                     f"peak_device_bytes={snap['peak_device_bytes']}, "
+                     f"rss_bytes={snap['rss_bytes']}"
+                     + (f", host[{cats}]" if cats else "")))
         src = MemTableSource.from_pydict(
             EXPLAIN_SCHEMA,
             {"plan_type": [t for t, _ in rows],

@@ -168,7 +168,10 @@ class JoinExec(PhysicalPlan):
 
         fn = self.governed_jit(("join.stats",), lambda: stats)
         scalars, live = fn(bb)
-        return jax.device_get(scalars), live
+        from ..observability import trace_span
+
+        with trace_span("device.block", site="join.stats"):
+            return jax.device_get(scalars), live
 
     def _pick_mode(self, stats, ncols: int) -> str:
         if ncols == 1:
@@ -551,16 +554,21 @@ class JoinExec(PhysicalPlan):
             # at most one pair per key column stays pinned
             cached = self._remap_cache.get(bcol)
             if cached is None or cached[0] is not bd or cached[1] is not pd_:
-                bvals = bd.values.astype(str)
-                pvals = pd_.values.astype(str)
-                if len(bvals):
-                    idx = np.searchsorted(bvals, pvals)
-                    idx_c = np.minimum(idx, len(bvals) - 1)
-                    ok = bvals[idx_c] == pvals
-                    remap = np.where(ok, idx_c, -1).astype(np.int64)
-                else:
-                    remap = np.full(max(len(pvals), 1), -1, np.int64)
-                cached = (bd, pd_, jnp.asarray(remap))
+                from ..observability import trace_span
+
+                with trace_span("host.dictionary", site="join.remap",
+                                column=bcol, n_build=len(bd),
+                                n_probe=len(pd_)):
+                    bvals = bd.values.astype(str)
+                    pvals = pd_.values.astype(str)
+                    if len(bvals):
+                        idx = np.searchsorted(bvals, pvals)
+                        idx_c = np.minimum(idx, len(bvals) - 1)
+                        ok = bvals[idx_c] == pvals
+                        remap = np.where(ok, idx_c, -1).astype(np.int64)
+                    else:
+                        remap = np.full(max(len(pvals), 1), -1, np.int64)
+                    cached = (bd, pd_, jnp.asarray(remap))
                 self._remap_cache[bcol] = cached
             out.append(cached[2])
         return tuple(out)
@@ -678,7 +686,11 @@ class JoinExec(PhysicalPlan):
             pend_bytes = 0
             if not pend:
                 return
-            totals = jax.device_get([p[-1] for p in pend])  # ONE sync
+            from ..observability import trace_span
+
+            with trace_span("device.block", site="join.expand_totals",
+                            n=len(pend)):
+                totals = jax.device_get([p[-1] for p in pend])  # ONE sync
             for (pb, remaps, out, out_cap, _), total in zip(pend, totals):
                 t = int(total)
                 while t > out_cap:  # rare: re-run at a ladder capacity

@@ -73,17 +73,22 @@ def _union_dicts(schema: Schema, batches: List[ColumnBatch]):
         if all(d is None or d is d0 for d in ds):
             dicts.append(d0)
             continue
-        union = np.unique(np.concatenate(
-            [np.asarray(d.values, dtype=object) for d in ds if d is not None]
-        ))
-        union_str = union.astype(str)
-        ud = Dictionary(union)
-        for bi, d in enumerate(ds):
-            if d is None or len(d) == 0:
-                continue
-            remaps[bi][i] = np.searchsorted(
-                union_str, d.values.astype(str)
-            ).astype(np.int32)
+        from ..observability import trace_span
+
+        with trace_span("host.dictionary", site="mesh.union",
+                        column=schema.fields[i].name, n_dicts=len(ds)):
+            union = np.unique(np.concatenate(
+                [np.asarray(d.values, dtype=object)
+                 for d in ds if d is not None]
+            ))
+            union_str = union.astype(str)
+            ud = Dictionary(union)
+            for bi, d in enumerate(ds):
+                if d is None or len(d) == 0:
+                    continue
+                remaps[bi][i] = np.searchsorted(
+                    union_str, d.values.astype(str)
+                ).astype(np.int32)
         dicts.append(ud)
     return dicts, remaps
 

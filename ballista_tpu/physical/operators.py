@@ -488,7 +488,11 @@ class RepartitionExec(PhysicalPlan):
                 pairs = ([mask_fn(batches[0], zero)] if batches else [])
                 pairs += parallel_map(lambda b: mask_fn(b, zero),
                                       batches[1:])
-                resolved = jax.device_get([c for _, c in pairs])
+                from ..observability import trace_span
+
+                with trace_span("device.block", site="repart.counts",
+                                n=len(pairs)):
+                    resolved = jax.device_get([c for _, c in pairs])
                 parts = [(b, perm, np.asarray(c))
                          for b, (perm, _), c in zip(batches, pairs,
                                                     resolved)]

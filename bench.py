@@ -426,6 +426,15 @@ def _run_bench(args) -> None:
         result["compile_count"] = int(st["backend_compiles"])
         result["compile_seconds"] = round(float(st["compile_seconds"]), 3)
         result["persistent_cache_hit"] = int(st["persistent_cache_hits"])
+        # memory trajectory (ISSUE 5): BENCH_*.json records peak RSS
+        # and peak device bytes alongside latency from this PR on
+        from ballista_tpu.observability import memory as obs_memory
+
+        result["peak_rss_mb"] = round(obs_memory.peak_rss_bytes() / 1e6, 1)
+        result["peak_device_bytes"] = int(
+            obs_memory.peak_device_bytes(refresh=True))
+        result["peak_host_tracked_bytes"] = int(
+            obs_memory.peak_host_bytes())
 
     def snapshot(phase: str):
         result["partial"] = phase

@@ -159,6 +159,21 @@ def apply_adaptive(fdp: dp.FileDescriptorProto) -> None:
               F.TYPE_UINT32)
 
 
+def apply_health(fdp: dp.FileDescriptorProto) -> None:
+    """PR 5: executor heartbeats carry resource gauges for the
+    scheduler's health plane (mirrored by hand in ballista.proto;
+    dev/check_proto_sync.py guards the drift)."""
+    if not has_message(fdp, "ExecutorResources"):
+        m = fdp.message_type.add(name="ExecutorResources")
+        add_field(m, "rss_bytes", 1, F.TYPE_UINT64)
+        add_field(m, "device_bytes", 2, F.TYPE_UINT64)
+        add_field(m, "inflight_tasks", 3, F.TYPE_UINT32)
+        add_field(m, "ingest_pool_depth", 4, F.TYPE_UINT32)
+        add_field(m, "peak_host_bytes", 5, F.TYPE_UINT64)
+    add_field(get_message(fdp, "ExecutorMetadata"), "resources", 5,
+              F.TYPE_MESSAGE, type_name=".ballista_tpu.ExecutorResources")
+
+
 TEMPLATE = '''# -*- coding: utf-8 -*-
 # Generated by dev/gen_proto_patch.py (no protoc in this image). DO NOT EDIT!
 # source: ballista.proto
@@ -187,6 +202,7 @@ def main() -> None:
     fdp = dp.FileDescriptorProto.FromString(blob)
     apply_observability(fdp)
     apply_adaptive(fdp)
+    apply_health(fdp)
     out = TEMPLATE.format(blob=fdp.SerializeToString())
     with open(PB2, "w") as f:
         f.write(out)

@@ -93,6 +93,44 @@ class DrainedProc:
         return self.popen.kill()
 
 
+def wait_healthz(port: int, timeout: float = 30.0,
+                 host: str = "127.0.0.1") -> dict:
+    """Poll the health plane's ``/healthz`` until it answers ``ok``
+    (returns the parsed body). Replaces fixed sleeps in cluster test
+    setup: the endpoint answers the moment the process can serve, so
+    startup waits cost milliseconds instead of a worst-case sleep, and
+    a dead process fails fast with the last error."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    deadline = time.time() + timeout
+    last_err: "Exception | None" = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/healthz", timeout=2) as resp:
+                body = json.loads(resp.read().decode())
+                if body.get("status") == "ok":
+                    return body
+                last_err = AssertionError(f"unexpected body: {body}")
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            last_err = e
+        time.sleep(0.05)
+    raise AssertionError(
+        f"/healthz on port {port} not ready after {timeout}s: {last_err}")
+
+
+def http_get(port: int, path: str, timeout: float = 5.0,
+             host: str = "127.0.0.1") -> str:
+    """One GET against a health plane endpoint; returns the body text."""
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"http://{host}:{port}{path}", timeout=timeout) as resp:
+        return resp.read().decode()
+
+
 def spawn_module(args, env) -> DrainedProc:
     """``python -m <args>`` with stdout+stderr drained."""
     return DrainedProc(subprocess.Popen(

@@ -12,7 +12,15 @@ import numpy as np
 import pytest
 
 from ballista_tpu import schema, Int64, Utf8
-from tests.procutil import spawn_module as _spawn
+from tests.procutil import (http_get, spawn_module as _spawn,
+                            wait_healthz)
+
+
+def _health_port(proc) -> int:
+    line = proc.wait_for(lambda ln: "health plane on" in ln)
+    m = re.search(r"health plane on [^:]+:(\d+)", line)
+    assert m, f"no health port in output: {line!r}"
+    return int(m.group(1))
 
 
 def test_binaries_end_to_end(tmp_path):
@@ -31,7 +39,11 @@ def test_binaries_end_to_end(tmp_path):
         m = re.search(r"listening on [^:]+:(\d+)", line)
         assert m, f"no port in scheduler output: {line!r}"
         port = int(m.group(1))
+        # readiness via the health plane, not sleeps/log scraping
+        sched_health = _health_port(sched)
+        assert wait_healthz(sched_health)["role"] == "scheduler"
 
+        exec_health = []
         for i in range(2):
             e = _spawn(["ballista_tpu.distributed.executor_main",
                         "--scheduler-host", "localhost",
@@ -39,7 +51,9 @@ def test_binaries_end_to_end(tmp_path):
                         "--work-dir", str(tmp_path / f"w{i}"),
                         "--num-devices", "1"], env)
             procs.append(e)
-            e.wait_for(lambda ln: "polling" in ln)
+            exec_health.append(_health_port(e))
+        for hp in exec_health:
+            assert wait_healthz(hp)["role"] == "executor"
 
         data = tmp_path / "t.tbl"
         data.write_text("".join(f"{i}|k{i % 3}|\n" for i in range(90)))
@@ -60,6 +74,13 @@ def test_binaries_end_to_end(tmp_path):
             assert got["c"][i] == f"k{i}"
             assert int(got["s"][i]) == int(a[m_].sum())
             assert int(got["n"][i]) == int(m_.sum())
+
+        # the REAL binaries serve the health plane: executor heartbeat
+        # gauges aggregated on the scheduler, job counters advanced
+        text = http_get(sched_health, "/metrics")
+        assert "ballista_executors_live 2" in text
+        assert "ballista_jobs_completed_total 1" in text
+        assert "ballista_executor_rss_bytes{" in text
     finally:
         for p in procs:
             p.send_signal(signal.SIGTERM)
@@ -103,7 +124,7 @@ def test_flight_frontend_against_real_cluster(tmp_path):
                     "--work-dir", str(tmp_path / "w0"),
                     "--num-devices", "1"], env)
         procs.append(e)
-        e.wait_for(lambda ln: "polling" in ln)
+        wait_healthz(_health_port(e))
 
         data = tmp_path / "t.tbl"
         data.write_text("".join(f"{i}|k{i % 3}|\n" for i in range(60)))

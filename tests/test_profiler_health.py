@@ -1,0 +1,449 @@
+"""Query profiler artifacts + live cluster health plane (ISSUE 5).
+
+Covers: Chrome-trace artifact schema + loadability, BALLISTA_PROFILE
+ambient profiling, structural span ids / flow correlation, trace-file
+hygiene knobs, /healthz + Prometheus /metrics + /debug/queries on the
+scheduler and executors (heartbeat resource gauges aggregated), the
+slow-query log, memory-accounting monotonicity, the metric-name lint,
+and the enabled-vs-disabled overhead gate (drift-cancelling
+measurement, same scheme as PR 1's metrics gate)."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ballista_tpu.client import BallistaContext
+from ballista_tpu.datatypes import Float64, Int64, Utf8, schema
+from ballista_tpu.observability import memory as obs_memory
+from ballista_tpu.observability import tracing as obs_tracing
+from ballista_tpu.observability.export import LANE_NAMES
+from ballista_tpu.observability.health import render_prometheus
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.fixture
+def ctx():
+    c = BallistaContext.standalone()
+    c.register_memtable(
+        "t", schema(("k", Utf8), ("a", Int64), ("b", Float64)),
+        {"k": ["x", "y", "z"] * 20,
+         "a": list(range(60)),
+         "b": [float(i) / 4 for i in range(60)]},
+    )
+    c.register_memtable(
+        "u", schema(("k", Utf8), ("w", Int64)),
+        {"k": ["x", "y", "z"], "w": [7, 11, 13]},
+    )
+    return c
+
+
+@pytest.fixture
+def clean_env():
+    keys = ("BALLISTA_TRACE", "BALLISTA_TRACE_FILE", "BALLISTA_TRACE_DIR",
+            "BALLISTA_TRACE_TRUNCATE", "BALLISTA_TRACE_MAX_MB",
+            "BALLISTA_PROFILE", "BALLISTA_SLOW_QUERY_SECS",
+            "BALLISTA_METRICS_PORT")
+    saved = {k: os.environ.get(k) for k in keys}
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    obs_tracing.reconfigure()
+
+
+# ---------------------------------------------------------------------------
+# (a) profile artifact: Chrome trace schema, lanes, loadability
+# ---------------------------------------------------------------------------
+
+
+_VALID_PH = {"X", "i", "M"}
+
+
+def _validate_chrome_trace(art: dict) -> None:
+    """Pin the Chrome trace event schema the artifact promises: what
+    chrome://tracing / Perfetto actually require of each event."""
+    events = art["traceEvents"]
+    assert isinstance(events, list) and events, "no trace events"
+    for ev in events:
+        assert ev["ph"] in _VALID_PH, ev
+        assert isinstance(ev["name"], str) and ev["name"], ev
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        elif ev["ph"] == "i":
+            assert ev.get("s") in ("t", "p", "g")
+        elif ev["ph"] == "M":
+            assert ev["name"] in ("process_name", "thread_name")
+            assert "name" in ev["args"]
+    assert art.get("displayTimeUnit") in ("ms", "ns")
+
+
+def test_profile_artifact_schema_and_lanes(ctx, clean_env, tmp_path):
+    df = ctx.sql(
+        "SELECT t.k, sum(t.a) AS s, sum(u.w) AS ws FROM t "
+        "JOIN u ON t.k = u.k WHERE t.a > 0 GROUP BY t.k ORDER BY t.k"
+    )
+    path = df.profile(path=str(tmp_path / "art.json"), label="join-agg")
+    art = json.load(open(path))
+
+    assert art["schema"] == "ballista-profile-v1"
+    assert art["label"] == "join-agg"
+    assert art["wall_seconds"] > 0
+    _validate_chrome_trace(art)
+
+    # the six named lanes exist, partition the wall clock (remainder
+    # included), and the coverage metric is the honest measured share
+    assert set(art["lanes"]) == set(LANE_NAMES)
+    assert all(v >= 0 for v in art["lanes"].values())
+    wall = art["wall_seconds"]
+    covered = (min(art["measured_seconds"], wall)
+               + art["lanes"]["xla_execute_other"])
+    assert abs(covered - wall) <= wall * 0.01 + 1e-6, art["lanes"]
+    assert 0.0 <= art["attributed_fraction"] <= 1.0
+    # this query compiles several kernels cold: the measured lanes must
+    # hold real time, not all-zeros-plus-remainder
+    assert art["lanes"]["compile_trace_lower"] > 0
+    # per-operator metrics merged into the same artifact
+    ops = art["operators"]
+    assert ops and any("HashAggregateExec" in r["operator"] for r in ops)
+    assert any(r["metrics"].get("output_rows", 0) > 0 for r in ops)
+    # memory plane snapshot rides along
+    mem = art["memory"]
+    assert mem["rss_bytes"] > 0 and "by_category" in mem
+    # artifact loads end-to-end: a fresh json round-trip is identical
+    assert json.loads(json.dumps(art)) == art
+
+
+def test_profile_env_dir_writes_artifact(ctx, clean_env, tmp_path):
+    out_dir = tmp_path / "profiles"
+    os.environ["BALLISTA_PROFILE"] = str(out_dir)
+    try:
+        ctx.sql("SELECT k, sum(a) AS s FROM t GROUP BY k").collect()
+    finally:
+        os.environ.pop("BALLISTA_PROFILE", None)
+    files = list(out_dir.glob("ballista-profile-*.json"))
+    assert len(files) == 1
+    art = json.load(open(files[0]))
+    _validate_chrome_trace(art)
+    assert 0.0 <= art["attributed_fraction"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# (b) structural span ids + flow correlation + trace hygiene
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def trace_file(clean_env, tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    os.environ["BALLISTA_TRACE"] = "1"
+    os.environ["BALLISTA_TRACE_FILE"] = path
+    obs_tracing.reconfigure()
+    yield path
+
+
+def test_span_ids_parents_and_flow(trace_file):
+    from ballista_tpu.observability import flow, trace_event, trace_span
+
+    with flow(job="j1", stage=2):
+        with trace_span("outer.span", task="t0"):
+            trace_event("inner.event", detail="x")
+            with trace_span("inner.span"):
+                pass
+    recs = {r["name"]: r for r in
+            (json.loads(ln) for ln in open(trace_file))}
+    outer, inner = recs["outer.span"], recs["inner.span"]
+    ev = recs["inner.event"]
+    # span ids are unique, parents structural (not timestamp guesses)
+    assert outer["sid"] != inner["sid"]
+    assert inner["psid"] == outer["sid"]
+    assert ev["psid"] == outer["sid"] and "sid" not in ev
+    assert "psid" not in outer
+    # flow attrs inherited by every record under the binding
+    for r in (outer, inner, ev):
+        assert r["job"] == "j1" and r["stage"] == 2
+    # explicit span attrs win over nothing-lost
+    assert outer["task"] == "t0"
+
+
+def test_prefetch_producer_inherits_flow(trace_file):
+    from ballista_tpu.ingest import PrefetchHandle
+    from ballista_tpu.observability import flow
+
+    with flow(job="jf", task="jf/0/0"):
+        h = PrefetchHandle(lambda: iter([1, 2]), depth=2, label="scan")
+    assert list(h) == [1, 2]
+    recs = [json.loads(ln) for ln in open(trace_file)]
+    pref = [r for r in recs if r["name"] == "ingest.prefetch"]
+    assert pref and pref[0].get("job") == "jf", pref
+
+
+def test_trace_truncate_and_size_cap(clean_env, tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    open(path, "w").write('{"name": "stale.old_run"}\n' * 100)
+    os.environ["BALLISTA_TRACE"] = "1"
+    os.environ["BALLISTA_TRACE_FILE"] = path
+    os.environ["BALLISTA_TRACE_TRUNCATE"] = "1"
+    os.environ["BALLISTA_TRACE_MAX_MB"] = "0.001"  # 1000 bytes
+    obs_tracing.reconfigure()
+    from ballista_tpu.observability import trace_event
+
+    for i in range(200):
+        trace_event("hygiene.spam", i=i, pad="y" * 50)
+    obs_tracing.reconfigure()  # flush/close
+    lines = [json.loads(ln) for ln in open(path)]
+    names = [r["name"] for r in lines]
+    assert "stale.old_run" not in names  # truncated on open
+    assert names[-1] == "trace.capped"  # cap marker, then silence
+    assert names.count("trace.capped") == 1
+    assert os.path.getsize(path) < 2000  # bounded despite 200 events
+
+
+# ---------------------------------------------------------------------------
+# (c) health plane: /healthz, /metrics, /debug/queries, heartbeat gauges
+# ---------------------------------------------------------------------------
+
+
+_PROM_LINE = re.compile(
+    r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^}]*\})? -?[0-9.e+-]+)$")
+
+
+def _parse_prometheus(text: str) -> dict:
+    """Validate the exposition format line by line; return
+    {family: {labelset_str: value}}."""
+    out = {}
+    for line in text.rstrip("\n").split("\n"):
+        assert _PROM_LINE.match(line), f"bad prometheus line: {line!r}"
+        if line.startswith("#"):
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (.+)$", line)
+        fam, labels, val = m.groups()
+        out.setdefault(fam, {})[labels or ""] = float(val)
+    return out
+
+
+def test_render_prometheus_format_and_registry_gate():
+    text = render_prometheus([
+        ("ballista_up", {}, 1),
+        ("ballista_executor_rss_bytes", {"executor": 'ab"12\\x'}, 5.5),
+        ("not_registered_family", {}, 9),
+    ])
+    fams = _parse_prometheus(text)
+    assert fams["ballista_up"][""] == 1
+    assert "not_registered_family" not in fams  # registry is the gate
+    # HELP/TYPE lines present per family
+    assert "# TYPE ballista_up gauge" in text
+    assert "# HELP ballista_executor_rss_bytes" in text
+
+
+def test_cluster_health_plane_end_to_end(clean_env, tmp_path):
+    from ballista_tpu.distributed.executor import LocalCluster
+    from tests.procutil import http_get, wait_healthz
+
+    os.environ["BALLISTA_SLOW_QUERY_SECS"] = "0.0"  # everything is slow
+    csv = tmp_path / "t.csv"
+    with open(csv, "w") as f:
+        f.write("k,a\n")
+        for i in range(40):
+            f.write(f"{'xy'[i % 2]},{i}\n")
+
+    cluster = LocalCluster(num_executors=2, metrics_port=0)
+    try:
+        sport = cluster.scheduler_health_port
+        eports = [e.health_port for e in cluster.executors]
+        assert sport and all(eports)
+        assert wait_healthz(sport)["role"] == "scheduler"
+        for p in eports:
+            assert wait_healthz(p)["role"] == "executor"
+
+        ctx = BallistaContext.remote("localhost", cluster.port)
+        ctx.register_csv("t", str(csv), schema(("k", Utf8), ("a", Int64)))
+        out = ctx.sql(
+            "SELECT k, sum(a) AS s FROM t GROUP BY k ORDER BY k").collect()
+        assert list(out["s"]) == [380, 400]
+
+        # wait until a post-completion heartbeat delivered gauges
+        deadline = time.time() + 15
+        fams = {}
+        while time.time() < deadline:
+            fams = _parse_prometheus(http_get(sport, "/metrics"))
+            if fams.get("ballista_jobs_completed_total", {}).get("") == 1 \
+                    and len(fams.get("ballista_executor_rss_bytes", {})) == 2:
+                break
+            time.sleep(0.1)
+        # scheduler aggregate: job counters + BOTH executors' resource
+        # gauges, labelled per executor, with live rss values
+        assert fams["ballista_jobs_submitted_total"][""] == 1
+        assert fams["ballista_jobs_completed_total"][""] == 1
+        assert fams["ballista_executors_live"][""] == 2
+        rss = fams["ballista_executor_rss_bytes"]
+        assert len(rss) == 2 and all(v > 0 for v in rss.values())
+        assert len(fams["ballista_executor_inflight_tasks"]) == 2
+        assert fams["ballista_tasks_dispatched_total"][""] >= 2
+
+        # executor-local /metrics: task counters + process memory
+        efams = _parse_prometheus(http_get(eports[0], "/metrics"))
+        assert efams["ballista_up"][""] == 1
+        assert "ballista_tasks_completed_total" in efams
+        assert efams["ballista_rss_bytes"][""] > 0
+
+        # /debug/queries: ring buffer carries the job, slow log caught
+        # it (threshold 0), and the executor ring shows its tasks
+        dbg = json.loads(http_get(sport, "/debug/queries"))
+        assert any(q.get("state") == "completed" for q in dbg["queries"])
+        assert dbg["slow_queries"] and dbg["slow_query_secs"] == 0.0
+        job = dbg["queries"][-1]
+        assert job["wall_seconds"] > 0 and job["num_stages"] >= 2
+        edbg = json.loads(http_get(eports[0], "/debug/queries"))
+        assert isinstance(edbg["queries"], list)
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# (d) memory accounting: categories, monotone peaks, operator gauges
+# ---------------------------------------------------------------------------
+
+
+def test_memory_accounting_monotone_and_categories():
+    base_peak = obs_memory.peak_host_bytes()
+    obs_memory.record_host_bytes("batches", 1000)
+    p1 = obs_memory.peak_host_bytes()
+    obs_memory.record_host_bytes("batches", 500)
+    p2 = obs_memory.peak_host_bytes()
+    obs_memory.release_host_bytes("batches", 1500)
+    p3 = obs_memory.peak_host_bytes()
+    # peaks are monotone within a query window: release never lowers
+    assert base_peak <= p1 <= p2 == p3
+    snap = obs_memory.host_memory_snapshot()
+    assert snap["peak_by_category"]["batches"] >= 1500
+    # double release clamps rather than going negative
+    obs_memory.release_host_bytes("batches", 10_000_000)
+    assert obs_memory.host_memory_snapshot()["by_category"]["batches"] >= 0
+
+
+def test_peak_memory_gauges_per_operator(ctx):
+    ctx.sql("SELECT k, sum(a) AS s FROM t GROUP BY k").collect()
+    qm = ctx.last_query_metrics()
+    gauged = [r for r in qm.operators()
+              if "peak_host_bytes" in r["metrics"]]
+    assert gauged, qm.pretty()
+    proc_peak = obs_memory.peak_host_bytes()
+    for r in gauged:
+        v = r["metrics"]["peak_host_bytes"]
+        assert 0 < v <= proc_peak  # operator peak within process peak
+    # EXPLAIN ANALYZE surfaces the memory plane
+    out = ctx.sql(
+        "EXPLAIN ANALYZE SELECT k, sum(a) AS s FROM t GROUP BY k").collect()
+    rows = dict(zip(out["plan_type"], out["plan"]))
+    assert "peak_host_bytes=" in rows["memory"]
+    assert "peak_device_bytes=" in rows["memory"]
+
+
+def test_dictionary_and_cache_categories_populate(tmp_path):
+    tbl = tmp_path / "d.tbl"
+    tbl.write_text("".join(f"{i}|v{i % 7}|\n" for i in range(50)))
+    ctx = BallistaContext.standalone()
+    ctx.register_tbl("d", str(tbl), schema(("a", Int64), ("c", Utf8)),
+                     cached=True)
+    ctx.sql("SELECT c, count(*) AS n FROM d GROUP BY c").collect()
+    snap = obs_memory.host_memory_snapshot()
+    assert snap["peak_by_category"].get("dictionaries", 0) > 0
+    assert snap["peak_by_category"].get("cache", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# (e) lint + overhead gate
+# ---------------------------------------------------------------------------
+
+
+def test_metric_name_registry_lint():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "dev", "check_metric_names.py")],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_profiler_endpoints_overhead_q1_under_5pct(tmp_path_factory,
+                                                   clean_env):
+    """Warm q1 with the observability surfaces of this PR enabled
+    (tracing to a file + a live health server answering scrapes) stays
+    within 5% of all-off — the drift-cancelling scheme from PR 1's
+    metrics gate (alternating interleaved samples, medians, retries)."""
+    from benchmarks.tpch import datagen
+    from benchmarks.tpch.schema_def import register_tpch
+    from ballista_tpu.observability.health import HealthServer
+    from tests.procutil import http_get
+
+    data_dir = str(tmp_path_factory.mktemp("tpch_prof"))
+    datagen.generate(data_dir, scale=0.01, num_parts=1)
+    ctx = BallistaContext.standalone()
+    register_tpch(ctx, data_dir, "tbl")
+    qdir = os.path.join(REPO, "benchmarks", "tpch", "queries")
+    df = ctx.sql(open(os.path.join(qdir, "q1.sql")).read())
+    df.collect()  # warm: jit compile + table caches
+
+    trace_path = str(tmp_path_factory.mktemp("trace") / "t.jsonl")
+    server = HealthServer("test", 0,
+                          samples_fn=lambda: [
+                              ("ballista_inflight_tasks", {}, 0)])
+
+    def set_enabled(on: bool):
+        if on:
+            os.environ["BALLISTA_TRACE"] = "1"
+            os.environ["BALLISTA_TRACE_FILE"] = trace_path
+        else:
+            os.environ.pop("BALLISTA_TRACE", None)
+            os.environ.pop("BALLISTA_TRACE_FILE", None)
+        obs_tracing.reconfigure()
+
+    def sample(on: bool):
+        set_enabled(on)
+        if on:
+            # a scrape between samples: endpoints live and answering
+            # while queries run, but out-of-band like a real scraper —
+            # not serialized into the query's critical path
+            http_get(server.port, "/metrics")
+        t0 = time.perf_counter()
+        for _ in range(3):
+            df.collect()
+        return time.perf_counter() - t0
+
+    try:
+        sample(True)
+        sample(False)
+
+        def measure():
+            offs, ons = [], []
+            for i in range(9):
+                if i % 2 == 0:
+                    offs.append(sample(False))
+                    ons.append(sample(True))
+                else:
+                    ons.append(sample(True))
+                    offs.append(sample(False))
+            return sorted(offs)[4], sorted(ons)[4]
+
+        for _attempt in range(3):
+            t_off, t_on = measure()
+            if t_on <= t_off * 1.05 + 2e-3:
+                return
+        overhead = (t_on - t_off) / t_off
+        raise AssertionError(
+            f"profiler/endpoints overhead {overhead:.1%} "
+            f"(on={t_on:.4f}s off={t_off:.4f}s)")
+    finally:
+        server.close()
+        set_enabled(False)
