@@ -280,6 +280,13 @@ class BallistaContext:
         if phys is None:
             phys = plan_logical(plan,
                                 PlannerOptions.from_settings(self.settings))
+        # whole-stage fusion (physical/fusion.py): merge each pipeline
+        # stage into one governed XLA program. Before prewarm (which
+        # targets fused-stage signatures) and before the adaptive pass
+        # (fused stages survive re-planning via with_new_children).
+        from .physical.fusion import maybe_fuse
+
+        phys = maybe_fuse(phys)
         if metrics_enabled():
             # cached plans re-execute: last_query_metrics() must report
             # THIS query, not the lifetime accumulation — and the reset
@@ -332,6 +339,24 @@ class BallistaContext:
             conf = AdaptiveConfig.from_settings(self.settings)
             if conf.enabled:
                 phys = apply_adaptive_rules(phys, conf)
+                # re-fuse subtrees the rewrite restructured (e.g. a
+                # demoted join's probe chain). Value-keyed signatures
+                # mean re-fused stages hit the existing governed
+                # entries — zero new compiles. Probe-chain fusion is
+                # skipped: a demoted join must keep the compiled probe
+                # programs it already has.
+                from .physical.fusion import fuse_plan, fusion_enabled
+
+                if fusion_enabled():
+                    phys = fuse_plan(phys, fuse_joins=False)
+                    try:
+                        # the re-fused root is cached: without the
+                        # marker the NEXT collect would re-run the full
+                        # pass (fuse_joins=True) and fuse the demoted
+                        # join's probe chain after all
+                        phys._fusion_applied = True
+                    except AttributeError:
+                        pass
         phys._adaptive_applied = True
         return phys
 

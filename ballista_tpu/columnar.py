@@ -111,7 +111,7 @@ class Dictionary:
     ride in pytree aux-data without defeating jit caching.
     """
 
-    __slots__ = ("values", "_index", "_tracked_bytes")
+    __slots__ = ("values", "_index", "_tracked_bytes", "_aot_fp")
 
     def __init__(self, values: Sequence[str]):
         self.values: np.ndarray = np.asarray(list(values), dtype=object)
@@ -152,6 +152,26 @@ class Dictionary:
     def encode(strings: Sequence[str]) -> Tuple["Dictionary", np.ndarray]:
         uniq, codes = np.unique(np.asarray(strings, dtype=object), return_inverse=True)
         return Dictionary(uniq), codes.astype(np.int32)
+
+    def content_fingerprint(self) -> str:
+        """Hex digest of the dictionary CONTENT (not identity) — the
+        fused-stage AOT cache keys compiled programs on it, because
+        traced programs bake dictionary values as constants. Cached per
+        instance (values are immutable by convention)."""
+        fp = getattr(self, "_aot_fp", None)
+        if fp is None:
+            import hashlib
+
+            h = hashlib.sha1()
+            for v in self.values:
+                b = str(v).encode("utf-8", "surrogatepass")
+                # length-prefixed: a separator alone is ambiguous when
+                # values can contain it (['a\x00','b'] vs ['a','\x00b'])
+                h.update(str(len(b)).encode())
+                h.update(b":")
+                h.update(b)
+            fp = self._aot_fp = h.hexdigest()
+        return fp
 
     def stable_hashes(self) -> np.ndarray:
         """int64 FNV-1a hash per dictionary value — STABLE across processes

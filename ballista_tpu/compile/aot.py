@@ -1,0 +1,497 @@
+"""Fused-stage AOT export/load: skip re-tracing in fresh processes.
+
+The XLA persistent compilation cache (PR 3) absorbs the *backend
+compile* of a warm-disk cold start, but every fresh process still pays
+jaxpr tracing + lowering for each program — the ~4.6s GIL-bound
+``compile_trace_lower`` lane the PR-5 profiler pinned on cold q5. With
+whole-stage fusion the programs are few and big, which makes them worth
+serializing whole: when ``BALLISTA_FUSION_AOT_DIR`` is set, AOT-eligible
+governed entries (``aot=True`` — every operator program routed through
+``PhysicalPlan.governed_jit``: the fused ``agg.*`` stage programs plus
+the join/sort/repartition/compact kernels whose first calls make up the
+rest of the cold compile lane) export their compiled StableHLO via
+``jax.export`` after the first real call, and a fresh process
+*deserializes and runs* the artifact instead of re-tracing.
+
+Correctness model:
+
+- Traced programs bake Python-visible state into the HLO: the governed
+  KEY fingerprints operator config (exprs/schemas/modes), and the
+  artifact additionally fingerprints the *call*: every leaf's
+  shape/dtype, the batch's schema, validity presence, and — critically
+  — each dictionary's CONTENT (string comparisons and hash tables lower
+  dictionary values into constants). Different data → different
+  fingerprint → no artifact hit; never a wrong answer.
+- Outputs are rebuilt from a structural proto saved with the artifact
+  (schema + per-column dtype/validity/dictionary values). Dictionary
+  objects are materialized ONCE per loaded artifact so identity-keyed
+  downstream caches see stable objects.
+- Everything is best-effort: any failure disables AOT for that entry
+  and falls back to the normal governed jit path.
+
+Artifacts are invalidated by name: the filename hashes the governed
+key, the call fingerprint, the jax version and the backend platform.
+Stale files are simply never hit; `BALLISTA_FUSION_AOT_DIR` can be
+wiped at any time.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+import threading
+from typing import Any, Iterator, List, Optional, Tuple
+
+log = logging.getLogger("ballista.compile.aot")
+
+_MISS = object()  # sentinel: no artifact for this call
+
+# One background worker drains all export jobs sequentially: exports
+# re-trace + backend-compile whole programs, so an uncapped
+# thread-per-artifact would compete with the measured query for every
+# core. The queue is bounded; overflow drops the export (a later
+# process simply re-tries).
+_EXPORT_QUEUE_CAP = 64
+# per-GC bound on same-revision artifacts kept on disk (oldest pruned)
+_DIR_CAP = 512
+# per-entry bound on exported call fingerprints: an entry whose
+# fingerprints embed per-dataset dictionary content would otherwise
+# export (re-trace + compile, on the worker) once per distinct dataset
+# forever — past this many, later variants just run the jit path
+_ENTRY_EXPORT_CAP = 8
+_export_queue: list = []
+_export_lock = threading.Lock()
+_export_worker: Optional[threading.Thread] = None
+
+
+def _enqueue_export(job) -> None:
+    global _export_worker
+    with _export_lock:
+        if len(_export_queue) >= _EXPORT_QUEUE_CAP:
+            return
+        _export_queue.append(job)
+        if _export_worker is None or not _export_worker.is_alive():
+            _export_worker = threading.Thread(
+                target=_drain_exports, name="ballista-aot-export",
+                daemon=True)
+            _export_worker.start()
+
+
+def _drain_exports() -> None:
+    global _export_worker
+    from .governor import _tls
+
+    # exports duplicate compiles the query already did (or will do):
+    # keep them out of the process-wide compile stats bench.py reports
+    _tls.suppress_stats = True
+    _gc_stale_artifacts()
+    while True:
+        with _export_lock:
+            if not _export_queue:
+                # clear the slot BEFORE returning (still under the
+                # lock): an enqueuer racing our exit must see either a
+                # non-empty queue (we drain it) or no live worker (it
+                # spawns one) — never a dying worker it trusts
+                _export_worker = None
+                return
+            job = _export_queue.pop(0)
+        try:
+            job()
+        except Exception:  # noqa: BLE001 - export is best-effort
+            log.exception("AOT export job failed")
+
+
+_GC_DONE = False
+
+
+def _gc_stale_artifacts() -> None:
+    """Unlink artifacts exported by OTHER code revisions (their
+    -src<fp> filename component can never match again): without this,
+    every source edit would orphan a full program set in a directory
+    bench.py populates by default. Once per process, best-effort."""
+    global _GC_DONE
+    if _GC_DONE:
+        return
+    _GC_DONE = True
+    d = aot_dir()
+    if d is None or not os.path.isdir(d):
+        return
+    tag = f"-src{_code_fingerprint()}.aot"
+    try:
+        current = []
+        for f in os.listdir(d):
+            if not f.endswith(".aot"):
+                continue
+            p = os.path.join(d, f)
+            if f.endswith(tag):
+                current.append(p)
+                continue
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        # same-revision artifacts are keyed on data content too (call
+        # fingerprints embed dictionary values), so changing datasets
+        # mint files that may never hit again: bound the directory by
+        # count, oldest first
+        if len(current) > _DIR_CAP:
+            current.sort(key=lambda p: os.path.getmtime(p))
+            for p in current[:-_DIR_CAP]:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+    except OSError:
+        pass
+
+
+def aot_dir() -> Optional[str]:
+    d = os.environ.get("BALLISTA_FUSION_AOT_DIR", "")
+    return d or None
+
+
+def _platform() -> str:
+    import jax
+
+    try:
+        return jax.devices()[0].platform
+    except Exception:  # noqa: BLE001 - backend not ready
+        return "unknown"
+
+
+_CODE_FP: Optional[str] = None
+
+
+def _code_fingerprint() -> str:
+    """Content hash of the engine's own Python sources, computed once
+    per process. Artifacts bake KERNEL CODE, not just operator config —
+    a bugfix to e.g. kernels/aggregate.py must invalidate every
+    artifact its old self produced, and the governed key only
+    fingerprints config. Riding in the filename makes stale-after-
+    upgrade artifacts inert instead of silently serving old programs."""
+    global _CODE_FP
+    if _CODE_FP is None:
+        h = hashlib.sha1()
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for root, dirs, files in os.walk(pkg):
+            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    p = os.path.join(root, f)
+                    h.update(os.path.relpath(p, pkg).encode())
+                    with open(p, "rb") as fh:
+                        h.update(fh.read())
+        _CODE_FP = h.hexdigest()[:12]
+    return _CODE_FP
+
+
+# ---------------------------------------------------------------------------
+# call fingerprinting (stable across processes)
+# ---------------------------------------------------------------------------
+
+
+class _AotUnsupported(Exception):
+    """Args/outputs outside the shapes this module serializes."""
+
+
+def _args_fingerprint(args: tuple) -> str:
+    from ..columnar import ColumnBatch
+
+    import jax
+
+    def walk(obj) -> tuple:
+        if obj is None:
+            return ("none",)
+        if isinstance(obj, (tuple, list)):
+            return ("seq",) + tuple(walk(x) for x in obj)
+        if isinstance(obj, dict):
+            return ("dict",) + tuple(
+                (str(k), walk(obj[k])) for k in sorted(obj))
+        if isinstance(obj, ColumnBatch):
+            return ("batch", repr(obj.schema), tuple(
+                (repr(c.dtype), c.validity is not None,
+                 c.dictionary.content_fingerprint()
+                 if c.dictionary is not None else None,
+                 tuple(c.values.shape), str(c.values.dtype))
+                for c in obj.columns))
+        if hasattr(obj, "shape") and hasattr(obj, "dtype"):
+            return ("arr", tuple(obj.shape), str(obj.dtype))
+        # other registered pytree nodes (e.g. the join kernel's
+        # BuildTable dataclass): hash the treedef repr + leaf avals.
+        # If a node's treedef repr is not process-stable (identity
+        # reprs), the fingerprint never matches across processes and
+        # AOT silently never hits — degraded, never wrong.
+        leaves, td = jax.tree_util.tree_flatten(obj)
+        if not leaves and repr(td).find("object at 0x") < 0:
+            return ("node", repr(td))
+        if leaves and all(hasattr(l, "shape") for l in leaves) \
+                and repr(td).find("object at 0x") < 0:
+            return ("node", repr(td)) + tuple(walk(l) for l in leaves)
+        raise _AotUnsupported(type(obj).__name__)
+
+    return hashlib.sha1(repr(walk(args)).encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# output protos: structural description + leaf consumption
+# ---------------------------------------------------------------------------
+
+
+def _encode_out(obj) -> tuple:
+    """Abstract output -> picklable structural proto. Dictionaries are
+    stored by VALUE (plain lists) so unpickling reconstructs them via
+    ``Dictionary.__init__`` and the memory accounting stays balanced."""
+    from ..columnar import ColumnBatch
+
+    if obj is None:
+        return ("none",)
+    if isinstance(obj, ColumnBatch):
+        return ("batch", obj.schema, tuple(
+            (c.dtype, c.validity is not None,
+             None if c.dictionary is None else list(c.dictionary.values))
+            for c in obj.columns))
+    if isinstance(obj, (tuple, list)):
+        return ("seq", isinstance(obj, tuple),
+                tuple(_encode_out(x) for x in obj))
+    if isinstance(obj, dict):
+        # jax flattens dicts in sorted-key order; decode mirrors it
+        return ("map", tuple(sorted(obj)),
+                tuple(_encode_out(obj[k]) for k in sorted(obj)))
+    if hasattr(obj, "shape"):
+        return ("leaf",)
+    import dataclasses
+
+    if dataclasses.is_dataclass(obj):
+        # registered-dataclass pytree nodes (kernels.join.BuildTable):
+        # jax flattens data fields in declaration order; decode rebuilds
+        # by importing the class and calling it positionally
+        cls = type(obj)
+        return ("dc", f"{cls.__module__}:{cls.__qualname__}",
+                tuple(_encode_out(getattr(obj, f.name))
+                      for f in dataclasses.fields(obj)))
+    raise _AotUnsupported(type(obj).__name__)
+
+
+def _materialize_dicts(proto: tuple) -> tuple:
+    """Proto -> proto with Dictionary objects built ONCE (per loaded
+    artifact), so every call reuses the same identity."""
+    from ..columnar import Dictionary
+
+    kind = proto[0]
+    if kind == "batch":
+        metas = tuple(
+            (dt, hv, Dictionary(dv) if dv is not None else None)
+            for dt, hv, dv in proto[2])
+        return ("batch", proto[1], metas)
+    if kind == "seq":
+        return ("seq", proto[1],
+                tuple(_materialize_dicts(x) for x in proto[2]))
+    if kind == "map":
+        return ("map", proto[1],
+                tuple(_materialize_dicts(x) for x in proto[2]))
+    if kind == "dc":
+        return ("dc", proto[1],
+                tuple(_materialize_dicts(x) for x in proto[2]))
+    return proto
+
+
+def _decode_out(proto: tuple, leaves: Iterator):
+    """Rebuild the output pytree, consuming ``leaves`` in the same
+    order ``jax.tree_util.tree_flatten`` produced them (ColumnBatch
+    flattening: per column values[, validity], then selection,
+    num_rows — see columnar._flatten_batch)."""
+    from ..columnar import Column, ColumnBatch
+
+    kind = proto[0]
+    if kind == "batch":
+        schema, metas = proto[1], proto[2]
+        cols: List[Column] = []
+        for dt, has_v, d in metas:
+            values = next(leaves)
+            validity = next(leaves) if has_v else None
+            cols.append(Column(values, dt, validity, d))
+        selection = next(leaves)
+        num_rows = next(leaves)
+        return ColumnBatch(schema, cols, selection, num_rows)
+    if kind == "seq":
+        as_tuple, items = proto[1], proto[2]
+        seq = [_decode_out(x, leaves) for x in items]
+        return tuple(seq) if as_tuple else seq
+    if kind == "map":
+        keys, items = proto[1], proto[2]
+        return {k: _decode_out(x, leaves) for k, x in zip(keys, items)}
+    if kind == "none":
+        return None
+    if kind == "dc":
+        import importlib
+
+        mod, _, qual = proto[1].partition(":")
+        cls = importlib.import_module(mod)
+        for part in qual.split("."):
+            cls = getattr(cls, part)
+        return cls(*(_decode_out(x, leaves) for x in proto[2]))
+    return next(leaves)
+
+
+# ---------------------------------------------------------------------------
+# the per-entry dispatcher
+# ---------------------------------------------------------------------------
+
+
+class AotEntry:
+    """AOT state for one governed entry: per-call-fingerprint loaded
+    artifacts, pending exports, and a disabled latch on any failure."""
+
+    __slots__ = ("key", "key_hash", "loaded", "exporting", "disabled",
+                 "lock")
+
+    def __init__(self, key: tuple):
+        self.key = key
+        self.key_hash = hashlib.sha1(repr(key).encode()).hexdigest()[:16]
+        self.loaded: dict = {}     # call fp -> callable | None (no file)
+        self.exporting: set = set()
+        self.disabled = False
+        self.lock = threading.Lock()
+
+    def _path(self, fp: str) -> str:
+        import jax
+
+        name = (f"{self.key_hash}-{fp[:16]}-{_platform()}"
+                f"-jax{jax.__version__}-src{_code_fingerprint()}.aot")
+        return os.path.join(aot_dir(), name)
+
+    def call(self, gf, args: tuple):
+        """Serve ``args`` from a loaded artifact, or return ``_MISS``
+        (and maybe schedule a background export) for the normal path."""
+        if self.disabled or aot_dir() is None:
+            return _MISS
+        try:
+            fp = _args_fingerprint(args)
+        except _AotUnsupported:
+            self.disabled = True
+            return _MISS
+        fn = self.loaded.get(fp, _MISS)
+        if fn is _MISS:
+            with self.lock:
+                # one load per fingerprint: concurrent partition
+                # executions must share ONE materialized artifact (its
+                # output Dictionary identities are the per-artifact
+                # constants downstream identity-keyed caches rely on)
+                fn = self.loaded.get(fp, _MISS)
+                if fn is _MISS:
+                    fn = self._load(fp)
+        if fn is not None:
+            import jax
+
+            try:
+                flat, _ = jax.tree_util.tree_flatten(args)
+                return fn(flat)
+            except Exception as e:  # noqa: BLE001 - stale/alien artifact
+                # deserialization succeeded but the CALL failed (e.g. an
+                # artifact from a different jaxlib build with the same
+                # jax version tag): disable the entry and fall back to
+                # the normal jit path — a cache dir must never be able
+                # to fail a query
+                log.warning("AOT artifact call failed for %r (%s); "
+                            "disabling AOT for this entry",
+                            self.key[:1], e)
+                self.disabled = True
+                return _MISS
+        # no artifact: run the normal path; export once in the background
+        with self.lock:
+            want_export = (fp not in self.exporting
+                           and len(self.exporting) < _ENTRY_EXPORT_CAP)
+            if want_export:
+                self.exporting.add(fp)
+        if want_export:
+            self._export_async(gf, args, fp)
+        return _MISS
+
+    def _load(self, fp: str):
+        path = self._path(fp)
+        fn = None
+        try:
+            if os.path.exists(path):
+                with open(path, "rb") as fh:
+                    blob = pickle.load(fh)
+                from jax import export as jexport
+
+                exported = jexport.deserialize(blob["exported"])
+                proto = _materialize_dicts(blob["out_proto"])
+
+                def run(flat, _e=exported, _p=proto):
+                    out_flat = _e.call(*flat)
+                    return _decode_out(_p, iter(out_flat))
+
+                fn = run
+                from ..observability import trace_event
+                from .governor import _STATS
+
+                _STATS["aot_loads"] += 1
+                trace_event("compile.aot", action="load",
+                            key=repr(self.key)[:160], path=path)
+        except Exception as e:  # noqa: BLE001 - fall back, stay correct
+            log.warning("AOT load failed for %r (%s); falling back to "
+                        "jit", self.key[:1], e)
+            fn = None
+        self.loaded[fp] = fn
+        return fn
+
+    def _export_async(self, gf, args: tuple, fp: str) -> None:
+        """Queue serialization of this entry's program for ``args`` on
+        the shared export worker (re-traces once off the hot path; the
+        artifact pays for itself on every later process)."""
+        import jax
+
+        try:
+            wrapped = gf.fn.__wrapped__
+        except AttributeError:
+            self.disabled = True
+            return
+        leaves, in_tree = jax.tree_util.tree_flatten(args)
+        avals = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves]
+
+        def work():
+            try:
+                out_abs = jax.eval_shape(wrapped,
+                                         *jax.tree_util.tree_unflatten(
+                                             in_tree, avals))
+                proto = _encode_out(out_abs)
+
+                def flat_fn(*flat):
+                    out = wrapped(*jax.tree_util.tree_unflatten(in_tree,
+                                                                flat))
+                    return jax.tree_util.tree_flatten(out)[0]
+
+                from jax import export as jexport
+
+                exported = jexport.export(jax.jit(flat_fn))(*avals)
+                blob = pickle.dumps({"exported": exported.serialize(),
+                                     "out_proto": proto})
+                path = self._path(fp)
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "wb") as fh:
+                    fh.write(blob)
+                os.replace(tmp, path)
+                from ..observability import trace_event
+                from .governor import _STATS
+
+                _STATS["aot_exports"] += 1
+                trace_event("compile.aot", action="export",
+                            key=repr(self.key)[:160], path=path)
+            except Exception as e:  # noqa: BLE001 - export best-effort
+                log.warning("AOT export failed for %r (%s)",
+                            self.key[:1], e)
+                self.disabled = True
+
+        _enqueue_export(work)
+
+
+def make_entry(key: tuple) -> Optional[AotEntry]:
+    """AotEntry for a governed key, or None when AOT is off."""
+    if aot_dir() is None:
+        return None
+    return AotEntry(key)

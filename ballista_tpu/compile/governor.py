@@ -65,6 +65,9 @@ _STATS: Dict[str, Any] = {
     "entries_built": 0,         # governed-key lookups that built one
     "prewarm_compiles": 0,      # compiles triggered by the prewarm pass
     "entry_trace_evictions": 0,  # within-entry jax trace-cache clears
+    "aot_loads": 0,             # fused-stage programs deserialized from
+                                # BALLISTA_FUSION_AOT_DIR (no re-trace)
+    "aot_exports": 0,           # fused-stage programs serialized to it
 }
 
 _tls = threading.local()
@@ -97,6 +100,8 @@ def _ensure_listener() -> None:
             return
 
         def on_duration(name: str, secs: float, **kw) -> None:
+            if getattr(_tls, "suppress_stats", False):
+                return  # AOT export worker: duplicate compiles
             if name == "/jax/core/compile/backend_compile_duration":
                 _STATS["backend_compiles"] += 1
                 _STATS["compile_seconds"] += secs
@@ -108,6 +113,8 @@ def _ensure_listener() -> None:
                 _STATS["trace_seconds"] += secs
 
         def on_event(name: str, **kw) -> None:
+            if getattr(_tls, "suppress_stats", False):
+                return
             if name == "/jax/compilation_cache/cache_hits":
                 _STATS["persistent_cache_hits"] += 1
                 f = getattr(_tls, "frame", None)
@@ -141,7 +148,7 @@ class GovernedFunction:
     handles shape and dictionary variation within the entry."""
 
     __slots__ = ("key", "fn", "calls", "compiles", "compile_seconds",
-                 "pcache_hits")
+                 "pcache_hits", "aot")
 
     def __init__(self, key: tuple, fn: Callable):
         self.key = key
@@ -150,6 +157,10 @@ class GovernedFunction:
         self.compiles = 0
         self.compile_seconds = 0.0
         self.pcache_hits = 0
+        # fused-stage AOT state (compile/aot.py), or None: set at entry
+        # creation when the caller opted in AND BALLISTA_FUSION_AOT_DIR
+        # is configured
+        self.aot = None
 
     def __call__(self, *args, **kwargs):
         return self.call_with(None, *args, **kwargs)
@@ -189,6 +200,29 @@ class GovernedFunction:
         ``metrics`` (an observability MetricsSet, or None)."""
         _STATS["governed_calls"] += 1
         self.calls += 1
+        if self.aot is not None and not kwargs:
+            # serve the whole program from a deserialized artifact when
+            # one matches this call's content fingerprint — no trace or
+            # lower; the exported module's one-time backend compile (or
+            # disk-cache retrieval) still happens inside the call and is
+            # attributed through the same frame machinery, so EXPLAIN
+            # ANALYZE and the profiler's compile lane stay honest. Any
+            # AOT failure falls through to the normal jit path.
+            from .aot import _MISS
+
+            prev = getattr(_tls, "frame", None)
+            frame = _Frame()
+            _tls.frame = frame
+            t0 = _PERF()
+            try:
+                out = self.aot.call(self, args)
+            finally:
+                _tls.frame = prev
+            if out is not _MISS:
+                if frame.compiles or frame.pcache_hits:
+                    self._record(frame, _PERF() - t0, metrics,
+                                 aot=True)
+                return out
         self._maybe_trim_traces()
         prev = getattr(_tls, "frame", None)
         frame = _Frame()
@@ -213,23 +247,32 @@ class GovernedFunction:
             if frame.compiles or frame.pcache_hits:
                 self._record(frame, _PERF() - t0, metrics)
 
-    def _record(self, frame: _Frame, call_secs: float, metrics) -> None:
+    def _record(self, frame: _Frame, call_secs: float, metrics,
+                aot: bool = False) -> None:
         self.compiles += frame.compiles
         self.compile_seconds += frame.compile_secs
         self.pcache_hits += frame.pcache_hits
         if metrics is not None:
             # elapsed_compile is the whole first call (upper bound: it
             # includes the first batch's execution, but compile dominates
-            # by orders of magnitude on a persistent-cache miss)
+            # by orders of magnitude on a persistent-cache miss). An
+            # AOT-loaded program never traces, so only the measured
+            # backend compile/retrieval counts for it.
             if frame.compiles:
                 metrics.add_counter("compile_count", frame.compiles)
-            metrics.add_time("elapsed_compile", call_secs)
+            metrics.add_time("elapsed_compile",
+                             frame.compile_secs if aot else call_secs)
             if frame.pcache_hits:
                 metrics.add_counter("persistent_cache_hits",
                                     frame.pcache_hits)
         from ..observability.tracing import trace_event
 
-        trace_event("compile.jit", key=_render_key(self.key),
+        # compile.aot records let the profiler's compile_trace_lower
+        # lane count only the real compile/retrieval seconds for loaded
+        # programs (their first-call execution is execution, not
+        # trace/lower)
+        trace_event("compile.aot" if aot else "compile.jit",
+                    key=_render_key(self.key),
                     compiles=frame.compiles,
                     compile_seconds=round(frame.compile_secs, 6),
                     persistent_cache_hits=frame.pcache_hits,
@@ -305,11 +348,13 @@ class CompileGovernor:
 
     def get(self, key: tuple, build: Callable[[], Callable], *,
             metrics=None, cap: Optional[int] = None,
-            jit_kwargs: Optional[dict] = None):
+            jit_kwargs: Optional[dict] = None, aot: bool = False):
         """The governed function for ``key`` (built via ``build()`` and
         jitted on first use). ``cap`` bounds the key's namespace (LRU).
         With ``metrics``, returns a bound wrapper that attributes
-        compiles to that MetricsSet."""
+        compiles to that MetricsSet. ``aot=True`` opts the entry into
+        fused-stage program serialization (compile/aot.py) when
+        ``BALLISTA_FUSION_AOT_DIR`` is configured."""
         _ensure_listener()
         ns = key[0] if key else "default"
         with self._lock:
@@ -322,6 +367,13 @@ class CompileGovernor:
             if gf is not None:
                 space.move_to_end(key)
                 _STATS["entry_hits"] += 1
+        if gf is not None and aot and gf.aot is None and not jit_kwargs:
+            # the entry may predate BALLISTA_FUSION_AOT_DIR being set
+            # (env is read at attach time); attach lazily so it still
+            # exports/loads
+            from .aot import make_entry
+
+            gf.aot = make_entry(key)
         if gf is None:
             # build OUTSIDE the lock: build() may itself request governed
             # entries (e.g. a mesh SPMD program wrapping an aggregate's
@@ -332,6 +384,10 @@ class CompileGovernor:
 
             gf = GovernedFunction(key, jax.jit(build(),
                                                **(jit_kwargs or {})))
+            if aot and not jit_kwargs:
+                from .aot import make_entry
+
+                gf.aot = make_entry(key)
             with self._lock:
                 # re-fetch: clear() may have swapped the namespace dict
                 # while we were building — inserting into the captured
@@ -380,10 +436,10 @@ def governor() -> CompileGovernor:
 
 def governed(key: tuple, build: Callable[[], Callable], *, metrics=None,
              cap: Optional[int] = None,
-             jit_kwargs: Optional[dict] = None):
+             jit_kwargs: Optional[dict] = None, aot: bool = False):
     """Module-level shorthand for ``governor().get(...)``."""
     return _GOVERNOR.get(key, build, metrics=metrics, cap=cap,
-                         jit_kwargs=jit_kwargs)
+                         jit_kwargs=jit_kwargs, aot=aot)
 
 
 def compile_stats() -> Dict[str, Any]:

@@ -59,10 +59,12 @@ def abstract_batch(schema, cap: int):
     )
 
 
-def _scan_capacity_hint(source) -> Optional[int]:
-    """Predicted per-partition emit capacity of a table source, or None
-    when it cannot be estimated. Mirrors the quantization the sources
-    apply at emit time (io/text.py / io/parquet.py)."""
+def _scan_estimate(source) -> "Optional[Tuple[int, int]]":
+    """(predicted per-partition emit capacity, estimated per-partition
+    rows) of a table source, or None when it cannot be estimated —
+    estimation may probe file metadata, so callers needing both figures
+    share one call. Mirrors the quantization the sources apply at emit
+    time (io/text.py / io/parquet.py)."""
     est = None
     try:
         est = source.estimated_rows()
@@ -80,33 +82,78 @@ def _scan_capacity_hint(source) -> Optional[int]:
     limit = getattr(inner, "_capacity", None)
     if isinstance(limit, int) and limit > 0:
         cap = min(cap, limit)
-    return cap
+    return cap, per_part
+
+
+def _scan_capacity_hint(source) -> Optional[int]:
+    hint = _scan_estimate(source)
+    return hint[0] if hint is not None else None
+
+
+def _fused_capacity_hint(source) -> Optional[int]:
+    """Predicted capacity of a fused stage's CONCATENATED scan input.
+    A chunked scan emits full chunks at the scanner's capacity limit
+    plus one remainder rung; the fused stage concats them (exact sum —
+    see base.concat_batches). Best-effort like everything here."""
+    hint = _scan_estimate(source)
+    if hint is None:
+        return None
+    per_part, rows = hint
+    if rows <= per_part:
+        return per_part
+    chunks, rem = divmod(rows, per_part)
+    return chunks * per_part + (bucket_capacity(rem) if rem else 0)
 
 
 def collect_targets(phys) -> List[Tuple[object, object]]:
-    """(fused governed fn, abstract input batch) for every pipeline
-    chain rooted directly on a table scan — the programs whose first
-    compile currently waits for parse + H2D to finish."""
+    """(governed fn, abstract input batch) for every program whose
+    first compile currently waits for parse + H2D to finish: fused
+    aggregate stages rooted on a table scan (the whole-stage-fusion
+    shape — prewarm and fusion share one key space), plus any bare
+    pipeline chain still rooted on a scan (e.g. join build sides)."""
     from ..physical.base import PipelineOp
+    from ..physical.fusion import FusedDistinctCountExec, FusedStageExec
     from ..physical.operators import ScanExec
 
     targets: List[Tuple[object, object]] = []
     seen = set()
 
+    def scan_batch(source: ScanExec, fused: bool):
+        cap = (_fused_capacity_hint(source.source) if fused
+               else _scan_capacity_hint(source.source))
+        if cap is None:
+            return None
+        try:
+            return abstract_batch(source.output_schema(), cap)
+        except Exception:  # noqa: BLE001 - exotic schema
+            return None
+
     def walk(node, parent_is_pipeline: bool) -> None:
+        if isinstance(node, (FusedStageExec, FusedDistinctCountExec)) \
+                and id(node) not in seen:
+            seen.add(id(node))
+            if isinstance(node.source, ScanExec):
+                batch = scan_batch(node.source, fused=True)
+                if batch is not None:
+                    if isinstance(node, FusedDistinctCountExec):
+                        fn = node._get_fn(node.group_capacity)
+                    elif node.group_exprs:
+                        fn = node._get_grouped_fn(node.group_capacity,
+                                                  batch.capacity)
+                    else:
+                        fn = node._get_scalar_fn()
+                    targets.append((fn, batch))
+            for c in node.children():
+                walk(c, False)
+            return
         is_pipe = isinstance(node, PipelineOp)
         if is_pipe and not parent_is_pipeline and id(node) not in seen:
             seen.add(id(node))
             chain, source = node._pipeline_chain()
             if isinstance(source, ScanExec):
-                cap = _scan_capacity_hint(source.source)
-                if cap is not None:
-                    try:
-                        batch = abstract_batch(source.output_schema(), cap)
-                    except Exception:  # noqa: BLE001 - exotic schema
-                        batch = None
-                    if batch is not None:
-                        targets.append((node._fused_governed(), batch))
+                batch = scan_batch(source, fused=False)
+                if batch is not None:
+                    targets.append((node._fused_governed(), batch))
         for c in node.children():
             walk(c, is_pipe)
 

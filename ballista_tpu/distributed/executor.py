@@ -219,6 +219,13 @@ class Executor:
         pid = PartitionId(td.task_id.job_id, td.task_id.stage_id,
                           td.task_id.partition_id)
         plan = serde.physical_from_proto(td.plan)
+        # whole-stage fusion happens AFTER deserialization, executor-
+        # side: the wire format never carries fused operators, and a
+        # re-planned stage's fresh task re-fuses to the same value-keyed
+        # signatures (zero new compiles)
+        from ..physical.fusion import maybe_fuse
+
+        plan = maybe_fuse(plan)
         shuffle = None
         if td.shuffle_output_partitions:
             hash_exprs = [

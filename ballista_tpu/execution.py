@@ -145,4 +145,7 @@ def collect(plan: LogicalPlan, options=None):
     """Logical plan -> pandas DataFrame (optimize, plan, execute, gather)."""
     import pandas as pd
 
-    return pd.DataFrame(collect_physical(plan_logical(plan, options)))
+    from .physical.fusion import maybe_fuse
+
+    return pd.DataFrame(
+        collect_physical(maybe_fuse(plan_logical(plan, options))))

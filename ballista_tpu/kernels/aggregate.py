@@ -45,6 +45,19 @@ from ..errors import ExecutionError
 # ---------------------------------------------------------------------------
 
 
+def _run_boundaries(cols: Sequence[jax.Array]) -> jax.Array:
+    """bool [N]: row i starts a new run of the (sorted) key columns —
+    ANY column differs from its predecessor (row 0 always starts one).
+    Shared by the sort-based grouping and the distinct-count kernel so
+    their byte-identical ordering contract stays in lockstep."""
+    first = None
+    for ks in cols:
+        diff = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), ks[1:] != ks[:-1]])
+        first = diff if first is None else jnp.logical_or(first, diff)
+    return first
+
+
 @dataclass
 class AggInput:
     """One aggregate to compute: op in {sum, count, min, max}."""
@@ -133,11 +146,7 @@ def grouped_aggregate(
         live_sorted = jnp.logical_not(sorted_ops[0])
 
     # a row starts a new group if live and ANY key differs from predecessor
-    first = None
-    for ks in sorted_keys:
-        diff = jnp.concatenate([jnp.ones((1,), jnp.bool_), ks[1:] != ks[:-1]])
-        first = diff if first is None else jnp.logical_or(first, diff)
-    starts = jnp.logical_and(first, live_sorted)
+    starts = jnp.logical_and(_run_boundaries(sorted_keys), live_sorted)
     gid = jnp.cumsum(starts.astype(jnp.int32)) - 1  # [-1..G-1]
     num_groups = jnp.sum(starts.astype(jnp.int32))
     # dead rows / overflow go to the trash segment group_capacity
@@ -201,6 +210,90 @@ def grouped_aggregate(
 
     return GroupedResult(rep_indices, group_valid, num_groups, results,
                          valid_results)
+
+
+def grouped_distinct_count(
+    group_keys: Sequence[jax.Array],  # [N] key columns (ints/codes)
+    live: jax.Array,  # bool [N] live-row mask
+    distinct_key: jax.Array,  # [N] the COUNT(DISTINCT x) column
+    group_capacity: int,
+    group_validities: Optional[Sequence[Optional[jax.Array]]] = None,
+    distinct_validity: Optional[jax.Array] = None,
+) -> GroupedResult:
+    """Single-pass COUNT(DISTINCT x) GROUP BY g1..gk.
+
+    The SQL planner rewrites COUNT(DISTINCT) into a two-level aggregate
+    (dedup on (g, x), then count per g) — three sort-based groupings over
+    the same rows. This kernel needs ONE lexicographic sort over
+    [dead, g.., x, idx]: a row opens a *group* when any g-key differs
+    from its predecessor, and opens a *distinct pair* when additionally x
+    differs — the per-group pair-start count IS the distinct count.
+
+    SQL semantics match the two-level rewrite exactly: NULL group keys
+    form their own group (validity rides the sort key), NULL x values
+    are never counted (but a group whose every x is NULL still appears,
+    with count 0). Input duplicates are fine — only pair boundaries
+    count. Output group order equals ``grouped_aggregate``'s (sorted by
+    the effective key encoding), so swapping the rewrite for this kernel
+    is byte-identical. Result carries one aggregate: the int64 counts.
+    """
+    group_keys = list(group_keys)
+    if not group_keys:
+        raise ExecutionError("grouped_distinct_count requires a group key")
+    if group_validities is None:
+        group_validities = [None] * len(group_keys)
+    eff_g: List[jax.Array] = []
+    for k, kv in zip(group_keys, group_validities):
+        if kv is not None:
+            eff_g.append(kv.astype(jnp.int32))
+            eff_g.append(jnp.where(kv, k, jnp.zeros((), k.dtype)))
+        else:
+            eff_g.append(k)
+    eff_d: List[jax.Array] = []
+    if distinct_validity is not None:
+        eff_d.append(distinct_validity.astype(jnp.int32))
+        eff_d.append(jnp.where(distinct_validity, distinct_key,
+                               jnp.zeros((), distinct_key.dtype)))
+    else:
+        eff_d.append(distinct_key)
+
+    n = live.shape[0]
+    dead = jnp.logical_not(live)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    ops = jax.lax.sort((dead, *eff_g, *eff_d, idx),
+                       num_keys=1 + len(eff_g) + len(eff_d),
+                       is_stable=True)
+    order = ops[-1]
+    live_sorted = jnp.logical_not(ops[0])
+    sg = ops[1:1 + len(eff_g)]
+    sd = ops[1 + len(eff_g):-1]
+
+    g_first = _run_boundaries(sg)
+    pair_first = jnp.logical_or(g_first, _run_boundaries(sd))
+    starts = jnp.logical_and(g_first, live_sorted)
+    gid = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    num_groups = jnp.sum(starts.astype(jnp.int32))
+    G = group_capacity
+    seg = jnp.where(live_sorted, jnp.minimum(gid, G), G)
+
+    # pairs whose x is NULL exist as groups' rows but never count
+    counted = jnp.logical_and(pair_first, live_sorted)
+    if distinct_validity is not None:
+        counted = jnp.logical_and(counted, distinct_validity[order])
+    counts = jax.ops.segment_sum(
+        counted.astype(jnp.int64), seg, num_segments=G + 1,
+        indices_are_sorted=True)[:G]
+
+    pos = jnp.arange(n, dtype=jnp.int32)
+    first_pos = jax.ops.segment_min(
+        jnp.where(live_sorted, pos, n), seg, num_segments=G + 1,
+        indices_are_sorted=True,
+    )[:G]
+    rep_indices = order[jnp.minimum(first_pos, n - 1)].astype(jnp.int32)
+    group_valid = jnp.arange(G, dtype=jnp.int32) < num_groups
+    counts = jnp.where(group_valid, counts, jnp.zeros((), counts.dtype))
+    return GroupedResult(rep_indices, group_valid, num_groups, [counts],
+                         [group_valid])
 
 
 def _max_ident(dt):

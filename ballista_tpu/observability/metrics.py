@@ -285,6 +285,15 @@ def reset_plan_metrics(plan) -> None:
         n.metrics().reset()
 
 
+def _fused_members(node) -> list:
+    """Operators a fused stage absorbed (physical/fusion.py): the
+    aggregate/distinct stage's pipeline chain, or a join's fused probe
+    chain. Outermost first, matching plan-render order."""
+    chain = list(getattr(node, "chain", ()) or ())
+    chain += list(getattr(node, "probe_chain", ()) or ())
+    return list(reversed(chain))
+
+
 def collect_plan_metrics(plan) -> List[dict]:
     """Pre-order walk of a physical plan -> one row per operator:
     ``{"operator", "depth", "metrics"}``. ``elapsed_compute`` is
@@ -299,6 +308,13 @@ def collect_plan_metrics(plan) -> List[dict]:
         vals = node.metrics().values()
         row = {"operator": node.display(), "depth": depth, "metrics": vals}
         rows.append(row)
+        # whole-stage fusion: operators absorbed into this node's traced
+        # program still get a row (marked), so metric consumers see the
+        # full logical plan; their work is attributed to the host row,
+        # same convention as pipeline-chain members
+        for member in _fused_members(node):
+            rows.append({"operator": member.display() + " [fused]",
+                         "depth": depth + 1, "metrics": {}})
         child_time = 0.0
         for c in node.children():
             child_time += walk(c, depth + 1)

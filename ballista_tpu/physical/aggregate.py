@@ -193,6 +193,14 @@ class HashAggregateExec(PhysicalPlan):
 
     # -- execution ----------------------------------------------------------
 
+    def _device_prologue(self, batch: ColumnBatch) -> ColumnBatch:
+        """Batch transform applied INSIDE every traced aggregation
+        program, before key/input evaluation. Identity here;
+        :class:`fusion.FusedStageExec` overrides it with the fused
+        pipeline chain (scan→filter→project→partial-agg as ONE XLA
+        program). Traced."""
+        return batch
+
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
         batches = list(self.child.execute(partition))
         if not batches:
@@ -337,6 +345,7 @@ class HashAggregateExec(PhysicalPlan):
         meta: List = []
 
         def probe(b):
+            b = self._device_prologue(b)
             kes, _ = self._inputs_and_keys(b)
             for r in kes:
                 meta.append((r.dtype, r.dictionary))
@@ -369,6 +378,7 @@ class HashAggregateExec(PhysicalPlan):
             tw = self.trace_twin()
 
             def stats(b):
+                b = tw._device_prologue(b)
                 kes, _ = tw._inputs_and_keys(b)
                 maxi = jnp.iinfo(jnp.int64).max
                 mm = []
@@ -501,6 +511,7 @@ class HashAggregateExec(PhysicalPlan):
             tw = self.trace_twin()  # don't pin the input subtree
 
             def run(batch: ColumnBatch):
+                batch = tw._device_prologue(batch)
                 key_evals, aggs = tw._inputs_and_keys(batch)
                 res = tw._run_grouping(batch, key_evals, aggs, cap)
                 return tw._assemble(batch, key_evals, res, cap), \
@@ -530,6 +541,7 @@ class HashAggregateExec(PhysicalPlan):
             G = round_capacity(g_total)
 
             def run(batch: ColumnBatch, bases):
+                batch = tw._device_prologue(batch)
                 key_evals, aggs = tw._inputs_and_keys(batch)
                 gid = jnp.zeros((batch.capacity,), jnp.int64)
                 bi = 0
@@ -586,11 +598,12 @@ class HashAggregateExec(PhysicalPlan):
 
     # ungrouped -------------------------------------------------------------
 
-    def _exec_scalar(self, batch: ColumnBatch) -> ColumnBatch:
+    def _get_scalar_fn(self):
         def build():
             tw = self.trace_twin()
 
             def run(b: ColumnBatch):
+                b = tw._device_prologue(b)
                 if tw.mode == "partial":
                     aggs = tw._agg_inputs_partial(b)
                 else:
@@ -599,7 +612,10 @@ class HashAggregateExec(PhysicalPlan):
 
             return run
 
-        vals, valids = self.governed_jit(("agg.scalar",), build)(batch)
+        return self.governed_jit(("agg.scalar",), build)
+
+    def _exec_scalar(self, batch: ColumnBatch) -> ColumnBatch:
+        vals, valids = self._get_scalar_fn()(batch)
 
         cap = 8
         sel = np.zeros(cap, dtype=bool)

@@ -87,9 +87,14 @@ class PhysicalPlan:
         ``subkey`` (namespace first); compiles it triggers are
         attributed to this operator's metrics. Replaces the per-instance
         ``self._jit_*`` dicts, which adaptive re-planning (new operator
-        instances) used to throw away."""
+        instances) used to throw away. Operator entries are AOT-eligible
+        (compile/aot.py): with ``BALLISTA_FUSION_AOT_DIR`` set, whole
+        programs serialize after first use and fresh processes
+        deserialize instead of re-tracing; entries whose call shapes the
+        AOT layer cannot fingerprint disable themselves safely."""
         key = (subkey[0], self.compile_signature()) + tuple(subkey[1:])
         metrics = self.metrics() if metrics_enabled() else None
+        kw.setdefault("aot", True)
         return governed(key, build, metrics=metrics, **kw)
 
     def trace_twin(self) -> "PhysicalPlan":
@@ -249,7 +254,8 @@ class PipelineOp(PhysicalPlan):
             key = ("pipeline.fused",
                    tuple(op.compile_signature() for op in chain))
             metrics = self.metrics() if metrics_enabled() else None
-            fused = self._fused_fn = governed(key, build, metrics=metrics)
+            fused = self._fused_fn = governed(key, build, metrics=metrics,
+                                              aot=True)
         return fused
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
@@ -424,7 +430,7 @@ def maybe_compact(batch: ColumnBatch, shrink_factor: int = 4,
 
         return compact
 
-    return governed(("batch.compact", new_cap), build)(batch)
+    return governed(("batch.compact", new_cap), build, aot=True)(batch)
 
 
 def pad_batch(batch: ColumnBatch, capacity: int) -> ColumnBatch:

@@ -100,6 +100,13 @@ class ExplainAnalyzeExec(PhysicalPlan):
                                              reset_plan_metrics,
                                              resolve_plan_pending)
 
+        # whole-stage fusion: ANALYZE measures (and renders) the same
+        # fused stages a plain collect would run. Applied here rather
+        # than at planning so the cluster path — which ships the inner
+        # plan over the wire unfused — fuses executor-side too.
+        from .fusion import maybe_fuse
+
+        self.inner = maybe_fuse(self.inner)
         # the inner plan may be cached (standalone DataFrames reuse
         # their physical plan across collects): report THIS run only
         reset_plan_metrics(self.inner)
@@ -118,9 +125,21 @@ class ExplainAnalyzeExec(PhysicalPlan):
                     # pipeline-breaker inputs, and those executions must
                     # be measured like the rest of the run
                     from ..adaptive.standalone import apply_adaptive_rules
+                    from .fusion import fuse_plan, fusion_enabled
 
                     self.inner = apply_adaptive_rules(self.inner,
                                                       self.adaptive_conf)
+                    if fusion_enabled():
+                        # re-fuse what the rewrite restructured (same
+                        # policy as the plain collect path); mark it so
+                        # a re-executed ANALYZE doesn't re-run the full
+                        # pass over the demoted shape
+                        self.inner = fuse_plan(self.inner,
+                                               fuse_joins=False)
+                        try:
+                            self.inner._fusion_applied = True
+                        except AttributeError:
+                            pass
                     self._adapted = True
                 for p in range(
                         self.inner.output_partitioning().num_partitions):
@@ -184,9 +203,14 @@ def render_explain(logical_input, physical_input: PhysicalPlan,
     verbose additionally shows the pre-optimization logical plan when the
     caller captured one.
     """
+    from .fusion import maybe_fuse
+
     rows: List[Tuple[str, str]] = []
     if verbose and unoptimized_text is not None:
         rows.append(("initial_logical_plan", unoptimized_text))
     rows.append(("logical_plan", logical_input.pretty()))
-    rows.append(("physical_plan", physical_input.pretty()))
+    # render the FUSED plan — EXPLAIN must show the fusion groups the
+    # standalone collect path will actually execute (text-only: the
+    # fused operators never serialize)
+    rows.append(("physical_plan", maybe_fuse(physical_input).pretty()))
     return ExplainExec(rows)

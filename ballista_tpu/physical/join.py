@@ -48,6 +48,8 @@ class JoinExec(PhysicalPlan):
         null_aware: bool = False,
         partitioned: bool = False,
         adaptive_note: Optional[str] = None,
+        probe_chain: Optional[List] = None,
+        probe_key_raw: Optional[dict] = None,
     ):
         if how not in JOIN_TYPES:
             raise NotImplementedError_(f"join type {how}")
@@ -67,6 +69,14 @@ class JoinExec(PhysicalPlan):
         self.partitioned = partitioned
         # set when adaptive execution rewrote this join (EXPLAIN surface)
         self.adaptive_note = adaptive_note
+        # whole-stage fusion (physical/fusion.py): the Filter/Projection
+        # chain that used to feed the probe side, applied INSIDE every
+        # traced probe program instead of as a separate per-batch jit.
+        # When set, ``probe`` is the chain's SOURCE; ``probe_key_raw``
+        # maps each post-chain probe key column name to its raw source
+        # column (for the host-side dictionary remap).
+        self.probe_chain = tuple(probe_chain or ())
+        self.probe_key_raw = dict(probe_key_raw or {})
         # partition -> (table, batch, unique, has_null, key mode,
         #               codec tables, build keys, build live)
         self._build_data = {}
@@ -83,15 +93,32 @@ class JoinExec(PhysicalPlan):
     def _signature_parts(self) -> tuple:
         # partitioned/adaptive_note steer HOST orchestration only — no
         # traced closure reads them, so a demoted (adaptive) join reuses
-        # the original join's compiled probes
+        # the original join's compiled probes. A fused probe chain IS
+        # traced, so its signatures ride the key.
         return (self.how, tuple(self.on), self.null_aware,
-                self.build.output_schema(), self.probe.output_schema())
+                self.build.output_schema(), self._probe_out_schema(),
+                tuple(op.compile_signature() for op in self.probe_chain))
+
+    def _probe_out_schema(self) -> Schema:
+        """Schema of probe batches AFTER the fused chain (equals the
+        probe child's schema when nothing is fused)."""
+        if self.probe_chain:
+            return self.probe_chain[-1].output_schema()
+        return self.probe.output_schema()
+
+    def _probe_prologue(self, pb: ColumnBatch) -> ColumnBatch:
+        """Fused probe-side chain (innermost first). Traced."""
+        for op in self.probe_chain:
+            pb = op.device_transform(pb)
+        return pb
 
     def _detach(self) -> None:
         from .base import SchemaLeaf
 
         self.build = SchemaLeaf(self.build.output_schema())
         self.probe = SchemaLeaf(self.probe.output_schema())
+        self.probe_chain = tuple(op.trace_twin()
+                                 for op in self.probe_chain)
         self._build_data = {}   # materialized build-side device buffers
         self._remap_cache = {}  # per-query dictionaries
 
@@ -245,7 +272,7 @@ class JoinExec(PhysicalPlan):
     # -- schema -------------------------------------------------------------
 
     def output_schema(self) -> Schema:
-        bs, ps = self.build.output_schema(), self.probe.output_schema()
+        bs, ps = self.build.output_schema(), self._probe_out_schema()
         if self.how in ("semi", "anti"):
             return ps
         seen = {f.name for f in bs.fields}
@@ -277,14 +304,20 @@ class JoinExec(PhysicalPlan):
     def with_new_children(self, children):
         return JoinExec(children[0], children[1], self.on, self.how,
                         self.null_aware, self.partitioned,
-                        self.adaptive_note)
+                        self.adaptive_note, list(self.probe_chain),
+                        self.probe_key_raw)
 
     def display(self) -> str:
         on = ", ".join(f"{l}={r}" for l, r in self.on)
         part = " partitioned" if self.partitioned else ""
         note = f" [adaptive: {self.adaptive_note}]" if self.adaptive_note \
             else ""
-        return f"JoinExec: how={self.how} on=[{on}]{part}{note}"
+        fused = ""
+        if self.probe_chain:
+            ops = "→".join(type(op).__name__.replace("Exec", "")
+                           for op in self.probe_chain)
+            fused = f" [fused probe: {ops}]"
+        return f"JoinExec: how={self.how} on=[{on}]{part}{note}{fused}"
 
     # -- execution ----------------------------------------------------------
 
@@ -360,7 +393,8 @@ class JoinExec(PhysicalPlan):
         if table is None:
             sorted_fn = governed(
                 ("join.sorted",), lambda: join_k.build_sorted_with_unique,
-                metrics=self.metrics() if metrics_enabled() else None)
+                metrics=self.metrics() if metrics_enabled() else None,
+                aot=True)
             table, uniq = sorted_fn(keys, live)
             unique = bool(uniq)
         self._build_data[key] = (table, bb, unique, has_null_key, mode,
@@ -379,6 +413,13 @@ class JoinExec(PhysicalPlan):
         if self.how == "anti" and self.null_aware and has_null_key:
             # SQL NOT IN with a NULL in the subquery: predicate is never
             # true -> empty result
+            if self.probe_chain:
+                # raw probe batches carry the SOURCE schema; emit one
+                # all-dead batch of the (post-chain) output schema
+                from ..columnar import empty_batch
+
+                yield empty_batch(self.output_schema())
+                return
             for pb in self.probe.execute(partition):
                 yield pb.with_selection(
                     jnp.zeros((pb.capacity,), jnp.bool_)
@@ -394,11 +435,15 @@ class JoinExec(PhysicalPlan):
                 yield maybe_compact(self._probe_unique_batch(
                     table, build_batch, pb, mode, key_tables, remaps))
         elif self.how in ("semi", "anti"):
-            # membership only: unique probe works regardless of build dups
+            # membership only: unique probe works regardless of build
+            # dups. Selective membership tests (q16's NOT IN keeps ~15%
+            # of partsupp) strand few live rows in probe-capacity
+            # batches; compacting shrinks every downstream shape, same
+            # policy as the unique path above
             for pb in self.probe.execute(partition):
                 remaps = self._remaps_for(build_batch, pb)
-                yield self._probe_unique_batch(table, build_batch, pb,
-                                               mode, key_tables, remaps)
+                yield maybe_compact(self._probe_unique_batch(
+                    table, build_batch, pb, mode, key_tables, remaps))
         else:
             yield from self._probe_expand_stream(
                 table, build_batch, self.probe.execute(partition), mode,
@@ -443,6 +488,7 @@ class JoinExec(PhysicalPlan):
             tw = self.trace_twin()
 
             def run(pb, key_tables, remaps, bkeys, blive):
+                pb = tw._probe_prologue(pb)
                 pkeys, plive = tw._probe_keys(pb, mode, key_tables, remaps)
                 pt = join_k.build_lookup(pkeys, plive)
                 _, matched = join_k.probe_unique(pt, bkeys, blive)
@@ -458,7 +504,7 @@ class JoinExec(PhysicalPlan):
         from ..columnar import Dictionary
 
         schema = self.output_schema()
-        ps = self.probe.output_schema()
+        ps = self._probe_out_schema()
         cols = []
         for f in schema.fields:
             if bb.schema.has_field(f.name):
@@ -536,7 +582,10 @@ class JoinExec(PhysicalPlan):
         out = []
         for bcol, pcol in self.on:
             bd = build_batch.column(bcol).dictionary
-            pd_ = pb.column(pcol).dictionary
+            # with a fused probe chain, pb is a RAW source batch: read
+            # the key column under its pre-chain name (fusion guarantees
+            # probe keys pass through the chain as plain references)
+            pd_ = pb.column(self.probe_key_raw.get(pcol, pcol)).dictionary
             if bd is None and pd_ is None:
                 out.append(None)
                 continue
@@ -580,6 +629,7 @@ class JoinExec(PhysicalPlan):
 
             def run(table, bb: ColumnBatch, pb: ColumnBatch,
                     key_tables, remaps) -> ColumnBatch:
+                pb = tw._probe_prologue(pb)
                 pkeys, plive = tw._probe_keys(pb, mode, key_tables, remaps)
                 build_rows, matched = join_k.probe_unique(table, pkeys, plive)
                 return tw._assemble(bb, pb, build_rows, matched,
@@ -600,6 +650,7 @@ class JoinExec(PhysicalPlan):
             tw = self.trace_twin()
 
             def run(table, bb, pb, key_tables, remaps, _cap=out_cap):
+                pb = tw._probe_prologue(pb)
                 pkeys, plive = tw._probe_keys(pb, mode, key_tables,
                                               remaps)
                 prows, brows, olive, total = join_k.probe_expand(
@@ -621,6 +672,7 @@ class JoinExec(PhysicalPlan):
             tw = self.trace_twin()
 
             def run_unmatched(table, bb, pb, key_tables, remaps):
+                pb = tw._probe_prologue(pb)
                 pkeys, plive = tw._probe_keys(pb, mode, key_tables,
                                               remaps)
                 counts = join_k.probe_counts(table, pkeys)
@@ -677,7 +729,7 @@ class JoinExec(PhysicalPlan):
             for f in self.output_schema().fields
         ) + sum(f.dtype.device_dtype().itemsize
                 * (getattr(f.dtype, "length", 0) or 1)
-                for f in self.probe.output_schema().fields)
+                for f in self._probe_out_schema().fields)
         pend: list = []
         pend_bytes = 0
 

@@ -30,6 +30,10 @@ PKG = os.path.join(HERE, "..", "ballista_tpu")
 # repo-relative files allowed to call jax.jit directly
 ALLOWLIST = {
     "ballista_tpu/compile/governor.py",  # THE jit site: the governor
+    # fused-stage AOT export wraps a governed entry's own (already
+    # governed) python function for jax.export serialization — it never
+    # creates an uncounted cache
+    "ballista_tpu/compile/aot.py",
 }
 
 # individual call sites elsewhere opt out with a trailing
@@ -69,7 +73,77 @@ def scan() -> List[Tuple[str, int, str]]:
     return hits
 
 
+# ---------------------------------------------------------------------------
+# program-count regression gate (--budget): whole-stage fusion exists to
+# keep the governed program count down; silent de-fusion (a matcher that
+# stops firing, a planner change that breaks the chain shape) would leak
+# programs back without failing any correctness test. The gate runs
+# q1+q5 on a tiny generated dataset with fusion ON and pins (a) that
+# fused operators are actually in the plans and (b) the number of
+# governed entries minted. Budget pinned from a measured 22 entries
+# (pre-fusion: 27 at the same scale) with small headroom for planner
+# drift — a de-fused q1 alone would add 3+ entries and trip it.
+# ---------------------------------------------------------------------------
+
+DEFAULT_ENTRY_BUDGET = 24
+
+
+def check_budget(budget: int = DEFAULT_ENTRY_BUDGET) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["BALLISTA_FUSION"] = "on"
+    import tempfile
+
+    sys.path.insert(0, os.path.abspath(os.path.join(HERE, "..")))
+    from benchmarks.tpch import datagen
+    from benchmarks.tpch.schema_def import register_tpch
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.compile import compile_stats
+    from ballista_tpu.physical.fusion import FusedStageExec
+    from ballista_tpu.physical.join import JoinExec
+
+    import shutil
+
+    d = tempfile.mkdtemp(prefix="jit_budget_")
+    try:
+        datagen.generate(d, scale=0.002, num_parts=2)
+        ctx = BallistaContext.standalone()
+        register_tpch(ctx, d, "tbl")
+        qdir = os.path.join(HERE, "..", "benchmarks", "tpch", "queries")
+        fused_seen = 0
+        for q in ("q1", "q5"):
+            df = ctx.sql(open(os.path.join(qdir, f"{q}.sql")).read())
+            df.collect()
+            phys = df._phys
+
+            def count_fused(node):
+                n = int(isinstance(node, FusedStageExec))
+                n += int(isinstance(node, JoinExec)
+                         and bool(node.probe_chain))
+                return n + sum(count_fused(c) for c in node.children())
+
+            fused_seen += count_fused(phys)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    if fused_seen == 0:
+        print("BUDGET: no FusedStageExec in the q1+q5 plans — "
+              "silent de-fusion", file=sys.stderr)
+        return 1
+    built = int(compile_stats()["entries_built"])
+    if built > budget:
+        print(f"BUDGET: q1+q5 minted {built} governed entries "
+              f"(budget {budget}) — fusion regressed", file=sys.stderr)
+        return 1
+    print(f"program budget ok: {built} governed entries <= {budget} "
+          f"({fused_seen} fused stages)")
+    return 0
+
+
 def main() -> int:
+    if "--budget" in sys.argv:
+        i = sys.argv.index("--budget")
+        n = (int(sys.argv[i + 1]) if len(sys.argv) > i + 1
+             else DEFAULT_ENTRY_BUDGET)
+        return check_budget(n)
     hits = scan()
     if hits:
         for rel, i, line in hits:
