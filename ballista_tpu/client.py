@@ -67,6 +67,9 @@ class BallistaContext:
         # on every query's critical path (the < 5% overhead gate)
         self._last_query_metrics = None
         self._last_query_phys = None
+        # job id of the last remote query: the handle df.profile() and
+        # /debug/profile/<job_id> take on the cluster path
+        self._last_job_id = None
 
     # -- constructors -------------------------------------------------------
 
@@ -215,10 +218,12 @@ class BallistaContext:
         from .distributed.client import remote_collect
 
         sink: list = []
+        jsink: list = []
         out = remote_collect(self.host, self.port, plan, self.settings,
-                             metrics_out=sink)
+                             metrics_out=sink, job_id_out=jsink)
         self._last_query_metrics = sink[0] if sink else None
         self._last_query_phys = None
+        self._last_job_id = jsink[0] if jsink else None
         return out
 
     def _standalone_collect(self, plan: LogicalPlan, phys=None):
@@ -233,11 +238,8 @@ class BallistaContext:
         if out_dir is not None and not obs_profiler.profiling_active():
             # label artifacts by a plan digest so a bench loop's files
             # are distinguishable per query shape
-            import hashlib
-
             try:
-                profile_label = ("query-" + hashlib.sha1(
-                    plan.pretty().encode()).hexdigest()[:10])
+                profile_label = "query-" + obs_profiler.plan_digest(plan)
             except Exception:  # noqa: BLE001 - label is cosmetic
                 profile_label = "query"
             box = {}
@@ -267,7 +269,16 @@ class BallistaContext:
             if path is not None:
                 plog.info("profile artifact written: %s", path)
             return box["r"]
-        return self._standalone_collect_inner(plan, phys)
+        # unprofiled run: the always-on flight recorder still lets a
+        # query that crosses BALLISTA_SLOW_QUERY_SECS dump a RETROACTIVE
+        # merged artifact after the fact (no-op when the knob is unset)
+        from .observability.distributed import watch_slow_query
+
+        def slow_label():
+            return "query-" + obs_profiler.plan_digest(plan)
+
+        with watch_slow_query(slow_label):
+            return self._standalone_collect_inner(plan, phys)
 
     def _standalone_collect_inner(self, plan: LogicalPlan, phys=None):
         import pandas as pd
@@ -490,12 +501,15 @@ class DataFrame:
             from .distributed.client import remote_sql_collect
 
             sink: list = []
+            jsink: list = []
             out = remote_sql_collect(
                 self.ctx.host, self.ctx.port, self._raw_sql,
                 self.ctx._catalog, self.ctx.settings, metrics_out=sink,
+                job_id_out=jsink,
             )
             self.ctx._last_query_metrics = sink[0] if sink else None
             self.ctx._last_query_phys = None
+            self.ctx._last_job_id = jsink[0] if jsink else None
             return out
         if self.ctx.mode == "standalone":
             out, self._phys = self.ctx._standalone_collect(
@@ -511,14 +525,15 @@ class DataFrame:
         """Execute the frame under the query profiler and write ONE
         Chrome-trace/Perfetto-compatible artifact (trace spans + ingest
         phases + compile attribution + per-operator metrics + named
-        wall-time lanes). Returns the artifact path. Standalone mode
-        only — cluster queries are profiled per process via
-        ``BALLISTA_TRACE`` on the scheduler/executors."""
+        wall-time lanes). Returns the artifact path.
+
+        On the cluster path the query runs normally and the SCHEDULER
+        builds the merged artifact — its own spans plus every
+        executor's per-task profile window, with per-process tracks,
+        task flow arrows and cluster-aggregated lanes — which this call
+        fetches over the GetJobProfile RPC and writes locally."""
         if self.ctx.mode != "standalone":
-            raise BallistaError(
-                "profile() runs standalone queries; for cluster queries "
-                "enable BALLISTA_TRACE on the scheduler/executor "
-                "processes and merge their trace files")
+            return self._profile_remote(path, label)
         from .observability import profiler as obs_profiler
 
         box = {}
@@ -537,6 +552,54 @@ class DataFrame:
             out_dir=obs_profiler.profile_dir(),
         )
         return artifact
+
+    def _profile_remote(self, path: Optional[str],
+                        label: Optional[str]) -> str:
+        """Cluster df.profile(): run the query, then pull the
+        scheduler-merged artifact for its job."""
+        from .distributed.client import fetch_job_profile
+        from .observability import profiler as obs_profiler
+        from .observability.export import write_artifact_file
+
+        self.collect()
+        job_id = self.ctx._last_job_id
+        if not job_id:
+            raise BallistaError(
+                "no job id recorded for the profiled query")
+        # the client can observe job completion BEFORE the scheduler's
+        # terminal-transition hook finalizes the job's profile window
+        # (completion is published first so result fetches never wait
+        # on observability) — briefly retry while the artifact is still
+        # marked partial, or while the scheduler holds no window at all
+        # yet (a job whose executors shipped no profiles creates its
+        # collector slot only at finalize)
+        import time as _time
+
+        from .distributed.client import SchedulerClient
+        from .errors import ClusterError
+
+        deadline = _time.time() + 10.0
+        sched = SchedulerClient(self.ctx.host, self.ctx.port)
+        try:
+            while True:
+                try:
+                    art = fetch_job_profile(self.ctx.host, self.ctx.port,
+                                            job_id, client=sched)
+                except ClusterError:
+                    if _time.time() > deadline:
+                        raise
+                    _time.sleep(0.25)
+                    continue
+                if not (art.get("distributed") or {}).get("partial") or \
+                        _time.time() > deadline:
+                    break
+                _time.sleep(0.25)
+        finally:
+            sched.close()
+        if label:
+            art["label"] = label
+        return write_artifact_file(art, out_dir=obs_profiler.profile_dir(),
+                                   out_path=path)
 
     def count(self) -> int:
         agg = Aggregate([], [ex.count().alias("__n")], self.plan)

@@ -136,15 +136,20 @@ def _job_timeout(settings: Optional[Dict[str, str]],
 def remote_collect(host: str, port: int, logical_plan,
                    settings: Optional[Dict[str, str]] = None,
                    timeout: Optional[float] = None,
-                   metrics_out: Optional[list] = None):
+                   metrics_out: Optional[list] = None,
+                   job_id_out: Optional[list] = None):
     """Submit + poll + fetch -> pandas DataFrame. ``metrics_out``
     (when a list) receives the job's per-stage QueryMetrics, which ride
-    the completed JobStatus (ctx.last_query_metrics())."""
+    the completed JobStatus (ctx.last_query_metrics()); ``job_id_out``
+    receives the scheduler-assigned job id (the handle the distributed
+    profiler's GetJobProfile / /debug/profile/<job_id> take)."""
     from ..execution import resolve_scalar_subqueries
 
     deadline = _job_timeout(settings, timeout)  # fail fast pre-submit
     logical_plan = resolve_scalar_subqueries(logical_plan)
     job_id = submit_plan(host, port, logical_plan, settings)
+    if job_id_out is not None:
+        job_id_out.append(job_id)
     result = wait_for_job(host, port, job_id, deadline)
     _deliver_metrics(result, metrics_out)
     return _fetch_result_frames(result)
@@ -153,13 +158,37 @@ def remote_collect(host: str, port: int, logical_plan,
 def remote_sql_collect(host: str, port: int, sql: str, catalog,
                        settings: Optional[Dict[str, str]] = None,
                        timeout: Optional[float] = None,
-                       metrics_out: Optional[list] = None):
+                       metrics_out: Optional[list] = None,
+                       job_id_out: Optional[list] = None):
     """Raw-SQL round trip: submit SQL + catalog, poll, fetch."""
     deadline = _job_timeout(settings, timeout)  # fail fast pre-submit
     job_id = submit_sql(host, port, sql, catalog, settings)
+    if job_id_out is not None:
+        job_id_out.append(job_id)
     result = wait_for_job(host, port, job_id, deadline)
     _deliver_metrics(result, metrics_out)
     return _fetch_result_frames(result)
+
+
+def fetch_job_profile(host: str, port: int, job_id: str,
+                      client: "SchedulerClient | None" = None) -> dict:
+    """Fetch the job's merged profile artifact from the scheduler
+    (distributed profiler). Raises ClusterError when the scheduler
+    holds no profile data for the job. Pass ``client`` to reuse one
+    channel across a polling loop."""
+    import json
+
+    own = client is None
+    if own:
+        client = SchedulerClient(host, port)
+    try:
+        res = client.GetJobProfile(pb.GetJobProfileParams(job_id=job_id))
+    finally:
+        if own:
+            client.close()
+    if res.error:
+        raise ClusterError(res.error)
+    return json.loads(res.artifact_json.decode())
 
 
 def _deliver_metrics(result: pb.GetJobStatusResult,

@@ -33,6 +33,9 @@ from .types import PartitionId
 log = logging.getLogger("ballista.executor")
 
 POLL_INTERVAL_SECS = 0.25  # reference: 250ms, execution_loop.rs:41
+# total task-profile bytes one PollWork may carry (well under the
+# transport's raised 64 MB cap; see scheduler._GRPC_MSG_OPTS)
+_POLL_PROFILE_BUDGET_BYTES = 8 << 20
 
 
 def _needs_mesh(plan) -> bool:
@@ -87,6 +90,13 @@ class Executor:
         # every member enters the SPMD program together
         self.mesh_group = mesh_group
         self.id = str(uuid.uuid4())
+        # distributed profiler: stamp this process's identity onto every
+        # trace/flight-recorder record (first writer wins — harmless for
+        # in-process LocalClusters, where per-task window extraction
+        # re-tags records with the owning executor's id instead)
+        from ..observability.tracing import set_process_identity
+
+        set_process_identity("executor", self.id)
         self._data_plane = start_data_plane(
             config.bind_host, config.port, config.work_dir,
             native=config.native_dataplane,
@@ -205,9 +215,23 @@ class Executor:
         params.metadata.resources.peak_host_bytes = \
             int(g["peak_host_bytes"])
         with self._status_lock:
-            for st in self._pending_status:
-                params.task_status.append(st)
+            pending = list(self._pending_status)
             self._pending_status.clear()
+        # profile windows are advisory observability payload: bound what
+        # one poll ships so a burst of completions (each profile up to
+        # 512 KiB) can never push the request past the transport's
+        # message limit — a failed PollWork would LOSE the completion
+        # reports it carried (pending was already cleared) and hang the
+        # job. Reports always go; overflow profiles are dropped.
+        budget = _POLL_PROFILE_BUDGET_BYTES
+        for st in pending:
+            if st.HasField("completed") and st.completed.HasField("profile"):
+                sz = st.completed.profile.ByteSize()
+                if sz > budget:
+                    st.completed.ClearField("profile")
+                else:
+                    budget -= sz
+            params.task_status.append(st)
         result = self._client.PollWork(params)
         if result.HasField("task"):
             self._run_task(result.task)
@@ -234,10 +258,21 @@ class Executor:
             shuffle = (hash_exprs or None, td.shuffle_output_partitions)
 
         def work():
+            from ..observability import distributed as obs_dist
             from ..observability.tracing import flow
 
             t0 = time.time()
             self._inflight += 1
+            # per-task profile window (distributed profiler): snapshot
+            # the process-wide ingest/compile accumulators up front so
+            # the completion payload can ship deltas alongside the
+            # flight-recorder span window
+            capture = obs_dist.task_profile_enabled()
+            if capture:
+                from ..compile import compile_stats
+                from ..ingest import phase_totals
+
+                phases0, compile0 = phase_totals(), compile_stats()
             try:
                 # flow(): every span/event emitted while this task runs
                 # (ingest producers included — PrefetchHandle re-binds
@@ -258,7 +293,16 @@ class Executor:
                             self.mesh_group.wait_acks(seq)
                     else:
                         stats = self.execute_partition(pid, plan, shuffle)
-                self._report_completed(pid, stats, td.stage_version)
+                profile = None
+                if capture:
+                    try:
+                        profile = obs_dist.capture_task_profile(
+                            pid.key(), t0, time.time() - t0, self.id,
+                            phases0=phases0, compile0=compile0)
+                    except Exception:  # noqa: BLE001 - observability
+                        log.exception("task profile capture failed")
+                self._report_completed(pid, stats, td.stage_version,
+                                       profile=profile)
                 self.tasks_completed += 1
                 self._query_log.record({
                     "task": pid.key(), "state": "completed",
@@ -396,7 +440,7 @@ class Executor:
         return {**totals, "path": base}
 
     def _report_completed(self, pid: PartitionId, stats: dict,
-                          stage_version: int = 0):
+                          stage_version: int = 0, profile=None):
         ts = pb.TaskStatus()
         ts.partition_id.job_id = pid.job_id
         ts.partition_id.stage_id = pid.stage_id
@@ -407,6 +451,8 @@ class Executor:
         tm = stats.get("task_metrics")
         if tm:
             serde.task_metrics_to_proto(tm, ts.completed.metrics)
+        if profile:
+            serde.task_profile_to_proto(profile, ts.completed.profile)
         serde.stats_to_proto(stats, ts.completed.stats)
         with self._status_lock:
             self._pending_status.append(ts)

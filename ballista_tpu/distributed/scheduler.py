@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 import random
 import string
 import threading
@@ -25,7 +26,7 @@ import grpc
 
 from ..errors import ClusterError
 from ..execution import plan_logical
-from ..observability import trace_event, trace_span
+from ..observability import trace_span
 from ..proto import ballista_pb2 as pb
 from .. import serde
 from .planner import (
@@ -39,6 +40,18 @@ from .types import ExecutorMeta, JobStatus, PartitionId, TaskStatus
 log = logging.getLogger("ballista.scheduler")
 
 SERVICE = "ballista_tpu.SchedulerGrpc"
+
+# Control-plane messages are small EXCEPT the distributed-profiler
+# payloads: a PollWork carrying several completed tasks' profile
+# windows (512 KiB each), and a GetJobProfile response serializing a
+# whole merged artifact. gRPC's 4 MB default receive limit would fail
+# exactly the jobs worth profiling — and a failed PollWork LOSES the
+# completion reports it carried (the executor clears its pending list
+# before the RPC). Applied to the server and every channel.
+_GRPC_MSG_OPTS = [
+    ("grpc.max_send_message_length", 64 << 20),
+    ("grpc.max_receive_message_length", 64 << 20),
+]
 
 
 def _fuse_mesh_stages(stages, n_mesh: int):
@@ -240,6 +253,23 @@ class SchedulerService:
         from ..adaptive.replanner import replan_on_stage_complete
 
         state.replan_hook = replan_on_stage_complete
+        # distributed profiler: the scheduler's own spans carry its
+        # identity; executor task-profile payloads (riding CompletedTask
+        # through PollWork) collect per job and merge — with the
+        # scheduler's flight-recorder window — into ONE Chrome-trace
+        # artifact per job (ambient BALLISTA_PROFILE, slow-query
+        # retroactive dump, GetJobProfile RPC, /debug/profile/<job_id>)
+        from ..observability.distributed import JobProfileCollector
+        from ..observability.tracing import set_process_identity
+
+        set_process_identity("scheduler")
+        self.profiles = JobProfileCollector()
+        # merge/render/write of terminal-job artifacts runs here, OFF
+        # the RPC handler threads (thread created lazily on first use:
+        # unprofiled schedulers never spawn it)
+        self._profile_pool = futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="profile-build")
+        state.profile_hook = self._on_job_terminal
         # health plane: /healthz + /metrics + /debug/queries. The
         # scheduler's /metrics additionally aggregates the resource
         # gauges executors ship with every heartbeat.
@@ -252,6 +282,7 @@ class SchedulerService:
         self.health = maybe_start_health_server(
             "scheduler", metrics_port, samples_fn=self._metric_samples,
             query_log=state.query_log,
+            profile_fn=self._profile_artifact,
         )
 
     def _metric_samples(self):
@@ -288,6 +319,80 @@ class SchedulerService:
     def close_health(self):
         if self.health is not None:
             self.health.close()
+        self._profile_pool.shutdown(wait=False)
+
+    # -- distributed profiler ------------------------------------------------
+
+    def _on_job_terminal(self, job_id: str, summary: dict, status) -> None:
+        """state.profile_hook: runs once per job at its terminal
+        transition, BEFORE the summary enters the query log. Observes
+        the per-stage duration histograms, and — under ambient
+        ``BALLISTA_PROFILE`` or for a slow query — builds the merged
+        artifact, writes it, and links it from the summary so
+        ``/debug/queries`` points straight at the evidence. Only the
+        ring snapshot happens here: the hook runs on the PollWork
+        handler thread (inside ``save_job_status``), so the expensive
+        merge/render/write is handed to a single background worker —
+        a multi-megabyte artifact must not stall task handout."""
+        from ..observability import profiler as obs_profiler
+        from ..observability import tracing
+        from ..observability.distributed import slow_query_dir
+        from ..observability.health import slow_query_secs
+        from ..observability.registry import observe_histogram
+
+        self.profiles.finalize(job_id, summary)
+        for sid, sm in (getattr(status, "stage_metrics", None) or {}).items():
+            observe_histogram("ballista_stage_seconds",
+                              {"stage": str(sid)},
+                              float(sm.get("elapsed_total", 0.0)))
+        thr = slow_query_secs()
+        slow = thr is not None and \
+            float(summary.get("wall_seconds", 0.0)) >= thr
+        out_dir = obs_profiler.profile_dir()
+        if out_dir is None and not slow:
+            return
+        # snapshot the scheduler's ring window NOW: by the time the
+        # worker runs, later queries may have evicted this job's spans
+        sched_records = tracing.ring_records(job=job_id)
+        wall = float(summary.get("wall_seconds", 0.0))
+        dest = out_dir if out_dir is not None else slow_query_dir()
+
+        def build_and_write():
+            try:
+                art = self.profiles.build(job_id, wall_seconds=wall,
+                                          sched_records=sched_records)
+                if art is None:
+                    return
+                for lane, secs in (art.get("lanes") or {}).items():
+                    observe_histogram("ballista_query_lane_seconds",
+                                      {"lane": lane}, float(secs))
+                from ..observability.export import write_artifact_file
+
+                try:
+                    path = write_artifact_file(art, out_dir=dest)
+                except OSError:
+                    log.exception("profile artifact write failed for "
+                                  "job %s", job_id)
+                    return
+                self.profiles.set_artifact(job_id, art, path)
+                # the ring records the summary BY COPY at the terminal
+                # transition, usually before this build finishes: set
+                # the source dict (covers a build outrunning record)
+                # AND annotate the recorded entries (the common case)
+                summary["profile_artifact"] = path
+                self.state.query_log.annotate(job_id,
+                                              profile_artifact=path)
+                log.info("merged profile artifact for job %s: %s",
+                         job_id, path)
+            except Exception:  # noqa: BLE001 - observability only
+                log.exception("profile build failed for job %s", job_id)
+
+        self._profile_pool.submit(build_and_write)
+
+    def _profile_artifact(self, job_id: str):
+        """/debug/profile/<job_id>: the job's merged artifact (built on
+        demand from the collector + flight recorder)."""
+        return self.profiles.build(job_id)
 
     # -- RPC: ExecuteQuery --------------------------------------------------
 
@@ -350,6 +455,14 @@ class SchedulerService:
         self.state.save_job_settings(job_id, settings or {})
         if logical_plan is None:
             logical_plan = self._plan_sql(sql, catalog_entries or [])
+        try:
+            # plan digest: identifies the query in slow-query summaries
+            # and profile artifacts without re-planning it
+            from ..observability.profiler import plan_digest
+
+            self.state.save_job_digest(job_id, plan_digest(logical_plan))
+        except Exception:  # noqa: BLE001 - digest is advisory
+            pass
         phys = plan_logical(logical_plan,
                             PlannerOptions.from_settings(settings))
         stages = DistributedPlanner().plan_query_stages(job_id, phys)
@@ -409,6 +522,17 @@ class SchedulerService:
         self.state.save_executor_metadata(meta)
         jobs_touched = set(self.state.reap_lost_tasks())
         for ts in request.task_status:
+            if ts.WhichOneof("status") == "completed" and \
+                    ts.completed.HasField("profile"):
+                # distributed profiler: the task's profile window is
+                # observability payload, not scheduling state — route it
+                # to the bounded collector before the status conversion
+                # (stale-version reports still ran; their spans count)
+                prof = serde.task_profile_from_proto(ts.completed.profile)
+                if prof is not None:
+                    self.profiles.add_task_profile(
+                        ts.partition_id.job_id, prof,
+                        nbytes=len(ts.completed.profile.records_json))
             st = _task_status_from_proto(ts)
             jobs_touched.add(st.partition.job_id)
             if not self.state.accept_report_version(st):
@@ -456,10 +580,16 @@ class SchedulerService:
                                 "%s", task.key(), meta.id)
             if task is not None:
                 try:
-                    result.task.CopyFrom(self._task_definition(task, meta))
+                    # a SPAN (not an instant): its duration is the real
+                    # per-task plan resolution cost, and the merged
+                    # artifact draws the flow arrow from this slice into
+                    # the matching executor.task slice
+                    with trace_span("scheduler.task_dispatch",
+                                    task=task.key(), job=task.job_id,
+                                    executor=meta.id[:8]):
+                        result.task.CopyFrom(
+                            self._task_definition(task, meta))
                     self.tasks_dispatched += 1
-                    trace_event("scheduler.task_dispatch", task=task.key(),
-                                executor=meta.id[:8])
                 except Exception as e:  # noqa: BLE001
                     log.exception("task resolution failed for %s", task)
                     st = TaskStatus(task, "failed", error=str(e))
@@ -552,6 +682,25 @@ class SchedulerService:
                 )
         return result
 
+    # -- RPC: GetJobProfile --------------------------------------------------
+
+    def GetJobProfile(self, request: pb.GetJobProfileParams, context=None):
+        """Serve the job's merged profile artifact (distributed
+        profiler): the remote ``df.profile()`` path. Built on demand
+        from the collected task payloads + the scheduler's
+        flight-recorder window when no ambient/slow build cached one."""
+        import json as _json
+
+        result = pb.GetJobProfileResult()
+        art = self.profiles.build(request.job_id)
+        if art is None:
+            result.error = (f"no profile data for job {request.job_id} "
+                            "(unknown job, or its window aged out of "
+                            "the bounded collector)")
+        else:
+            result.artifact_json = _json.dumps(art, default=str).encode()
+        return result
+
     # -- RPC: GetExecutorsMetadata ------------------------------------------
 
     def GetExecutorsMetadata(self, request, context=None):
@@ -605,8 +754,6 @@ def _hash_column_names_cached(hx_bytes: tuple) -> tuple:
 def _expand_shuffle_locations(producer_locs, n_out: int):
     """Per-producer completed-task locations -> one location per
     (producer, consumer-partition) shuffle file."""
-    import os
-
     from .dataplane import shuffle_file_name
     from .types import PartitionLocation
 
@@ -657,6 +804,7 @@ _RPCS = {
     "ExecuteQuery": (pb.ExecuteQueryParams, pb.ExecuteQueryResult),
     "PollWork": (pb.PollWorkParams, pb.PollWorkResult),
     "GetJobStatus": (pb.GetJobStatusParams, pb.GetJobStatusResult),
+    "GetJobProfile": (pb.GetJobProfileParams, pb.GetJobProfileResult),
     "GetExecutorsMetadata": (
         pb.GetExecutorsMetadataParams, pb.GetExecutorsMetadataResult,
     ),
@@ -680,7 +828,8 @@ def serve_scheduler(state: SchedulerState, host: str = "0.0.0.0",
             request_deserializer=req_t.FromString,
             response_serializer=lambda m: m.SerializeToString(),
         )
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
+                         options=_GRPC_MSG_OPTS)
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE, handlers),)
     )
@@ -693,7 +842,8 @@ class SchedulerClient:
     """Thin typed client over the generic gRPC channel."""
 
     def __init__(self, host: str, port: int):
-        self.channel = grpc.insecure_channel(f"{host}:{port}")
+        self.channel = grpc.insecure_channel(f"{host}:{port}",
+                                             options=_GRPC_MSG_OPTS)
         self._stubs = {}
         for name, (req_t, resp_t) in _RPCS.items():
             self._stubs[name] = self.channel.unary_unary(

@@ -213,6 +213,13 @@ class SchedulerState:
         self.jobs_completed = 0
         self.jobs_failed = 0
         self._job_started: Dict[str, float] = {}
+        # distributed profiler: per-job logical-plan digests (so a slow
+        # query is identifiable after the fact without re-planning) and
+        # the terminal-transition hook the scheduler service installs —
+        # profile_hook(job_id, summary, status) may build the merged
+        # artifact and enrich the summary before it enters the query log
+        self._job_digests: Dict[str, str] = {}
+        self.profile_hook = None
         self._rehydrate()
 
     def _rehydrate(self):
@@ -304,13 +311,40 @@ class SchedulerState:
                     "wall_seconds": round(time.time() - t0, 4),
                     "num_stages": len(self.stage_ids(job_id)),
                 }
+                # pop: the digest's job is done (the summary carries it
+                # on), and the dict must not grow one entry per job for
+                # the scheduler's lifetime
+                digest = self._job_digests.pop(job_id, None)
+                if digest:
+                    # a slow query must be diagnosable after the fact:
+                    # the plan digest identifies WHAT ran without
+                    # re-planning it
+                    summary["plan_digest"] = digest
                 if status.error:
                     summary["error"] = str(status.error)[:300]
+                if self.profile_hook is not None:
+                    # runs ONCE per job (t0 was just popped); may build
+                    # the merged profile artifact and attach its path to
+                    # the summary. Best-effort: observability must never
+                    # take the job's terminal transition down.
+                    try:
+                        self.profile_hook(job_id, summary, status)
+                    except Exception:  # noqa: BLE001
+                        log.exception("profile hook failed for job %s",
+                                      job_id)
                 self.query_log.record(summary)
 
     def get_job_status(self, job_id: str) -> Optional[JobStatus]:
         v = self.kv.get(self._k("jobs", job_id))
         return pickle.loads(v) if v is not None else None
+
+    def save_job_digest(self, job_id: str, digest: str):
+        """Stable digest of the job's logical plan (in-memory, advisory:
+        feeds slow-query summaries and profile artifact labels)."""
+        self._job_digests[job_id] = digest
+
+    def get_job_digest(self, job_id: str) -> Optional[str]:
+        return self._job_digests.get(job_id)
 
     def save_job_settings(self, job_id: str, settings: Dict[str, str]):
         """Client ``settings`` of the submitted query, kept for the

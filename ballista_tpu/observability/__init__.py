@@ -28,7 +28,10 @@ from .metrics import (  # noqa: F401
 )
 from .tracing import (  # noqa: F401
     current_flow,
+    flight_recorder_enabled,
     flow,
+    ring_records,
+    set_process_identity,
     trace_enabled,
     trace_event,
     trace_span,

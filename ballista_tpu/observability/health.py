@@ -13,6 +13,10 @@ threads only, one instance per process role:
   registry is the contract ``dev/check_metric_names.py`` lints).
 - ``GET /debug/queries`` — JSON ring buffer of recent query summaries
   plus the slow-query subset (``BALLISTA_SLOW_QUERY_SECS``).
+- ``GET /debug/profile/<job_id>`` — the job's merged Chrome-trace
+  profile artifact (scheduler only; served from the distributed
+  profiler's collector, built on demand from the flight recorder when
+  no ambient/slow-query build happened).
 
 Servers bind ``127.0.0.1`` by default (diagnosis plane, not a public
 API); ``port=0`` picks an ephemeral port (read ``server.port``)."""
@@ -28,7 +32,8 @@ from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
-from .registry import PROCESS_METRICS
+from .registry import (HISTOGRAM_BUCKETS, PROCESS_METRICS,
+                       histogram_snapshot)
 
 log = logging.getLogger("ballista.health")
 
@@ -76,6 +81,16 @@ class QueryLog:
             log.warning("slow query (>= %.3fs): %s", thr,
                         json.dumps(entry, default=str))
 
+    def annotate(self, job_id: str, **fields) -> None:
+        """Attach fields to already-recorded entries of a job —
+        ``record`` copies its input, so late-arriving facts (the
+        deferred profile-artifact path) land through here."""
+        with self._lock:
+            for ring in (self._recent, self._slow):
+                for e in ring:
+                    if e.get("job_id") == job_id:
+                        e.update(fields)
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -104,20 +119,47 @@ def render_prometheus(samples: List[Sample]) -> str:
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {ptype}")
         for _, labels, value in by_family[name]:
-            label_s = ""
-            if labels:
-                inner = ",".join(
-                    '{}="{}"'.format(
-                        k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
-                    for k, v in sorted(labels.items())
-                )
-                label_s = "{" + inner + "}"
+            label_s = _label_str(labels)
             if float(value) == int(value):
                 vs = str(int(value))
             else:
                 vs = repr(float(value))
             lines.append(f"{name}{label_s} {vs}")
     return "\n".join(lines) + "\n"
+
+
+def _label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        '{}="{}"'.format(k, str(v).replace("\\", "\\\\")
+                         .replace('"', '\\"'))
+        for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def render_histograms() -> str:
+    """Prometheus text for every registered histogram family with
+    observations (``registry.observe_histogram``): cumulative
+    ``_bucket{le=...}`` series plus ``_sum`` / ``_count``."""
+    lines: List[str] = []
+    for family, rows in sorted(histogram_snapshot().items()):
+        if PROCESS_METRICS.get(family, (None,))[0] != "histogram":
+            continue  # registry is the gate, here too
+        help_text = PROCESS_METRICS[family][1]
+        lines.append(f"# HELP {family} {help_text}")
+        lines.append(f"# TYPE {family} histogram")
+        for labels, counts, total, n in rows:
+            for le, c in zip(HISTOGRAM_BUCKETS, counts):
+                ls = _label_str({**labels, "le": f"{le:g}"})
+                lines.append(f"{family}_bucket{ls} {c}")
+            ls = _label_str({**labels, "le": "+Inf"})
+            lines.append(f"{family}_bucket{ls} {n}")
+            ls = _label_str(labels)
+            lines.append(f"{family}_sum{ls} {round(total, 6)}")
+            lines.append(f"{family}_count{ls} {n}")
+    return "\n".join(lines) + "\n" if lines else ""
 
 
 def base_process_samples() -> List[Sample]:
@@ -146,10 +188,15 @@ class HealthServer:
     def __init__(self, role: str, port: int = 0,
                  samples_fn: Optional[Callable[[], List[Sample]]] = None,
                  query_log: Optional[QueryLog] = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 profile_fn: Optional[Callable[[str],
+                                              Optional[dict]]] = None):
         self.role = role
         self.query_log = query_log or QueryLog()
         self._samples_fn = samples_fn
+        # profile_fn(job_id) -> merged profile artifact dict (or None):
+        # serves /debug/profile/<job_id> on the scheduler
+        self._profile_fn = profile_fn
         self._started_at = time.time()
         plane = self
 
@@ -178,6 +225,16 @@ class HealthServer:
                         body = json.dumps(plane.query_log.snapshot(),
                                           default=str).encode()
                         self._send(200, body, "application/json")
+                    elif path.startswith("/debug/profile/") and \
+                            plane._profile_fn is not None:
+                        job_id = path[len("/debug/profile/"):]
+                        art = plane._profile_fn(job_id)
+                        if art is None:
+                            self._send(404, b"no profile for that job",
+                                       "text/plain")
+                        else:
+                            body = json.dumps(art, default=str).encode()
+                            self._send(200, body, "application/json")
                     else:
                         self._send(404, b"not found", "text/plain")
                 except Exception:  # noqa: BLE001 - never kill the plane
@@ -215,7 +272,7 @@ class HealthServer:
             except Exception:  # noqa: BLE001 - plane must stay up
                 log.exception("metrics sample callback failed")
         samples.extend(base_process_samples())
-        return render_prometheus(samples)
+        return render_prometheus(samples) + render_histograms()
 
     def close(self) -> None:
         try:
@@ -234,14 +291,15 @@ def metrics_port_from_env(default: int = -1) -> int:
 
 
 def maybe_start_health_server(role: str, port: Optional[int],
-                              samples_fn=None, query_log=None
+                              samples_fn=None, query_log=None,
+                              profile_fn=None
                               ) -> Optional[HealthServer]:
     """Start a health server unless disabled (``port`` None/negative)."""
     if port is None or port < 0:
         return None
     try:
         return HealthServer(role, port, samples_fn=samples_fn,
-                            query_log=query_log)
+                            query_log=query_log, profile_fn=profile_fn)
     except OSError as e:
         log.warning("health plane for %s failed to bind port %s: %s",
                     role, port, e)

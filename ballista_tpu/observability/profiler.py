@@ -22,6 +22,10 @@ and ``export.py`` merges them into ONE Chrome-trace/Perfetto-compatible
 JSON artifact with named lane attribution. Entry points:
 ``DataFrame.profile()`` (standalone) and ``BALLISTA_PROFILE=<dir>``
 (every standalone ``collect()`` writes an artifact into the directory).
+The CLUSTER path does not use this window class: executors ship
+per-task span windows with ``CompletedTask`` and the scheduler merges
+them per job (``observability/distributed.py``), so the same env var /
+``df.profile()`` surface works identically there.
 
 One window per process: overlapping profilers are refused
 (:class:`ProfilerBusy`; the ambient path degrades the loser to an
@@ -83,6 +87,16 @@ def profile_dir() -> Optional[str]:
     if v.lower() in ("1", "on", "true"):
         return os.getcwd()
     return v
+
+
+def plan_digest(plan, n: int = 12) -> str:
+    """Stable short digest of a logical plan's pretty-printed form —
+    ONE format for every surface (artifact labels, slow-query
+    summaries, scheduler job digests), so a digest seen in
+    ``/debug/queries`` greps straight into artifact filenames."""
+    import hashlib
+
+    return hashlib.sha1(plan.pretty().encode()).hexdigest()[:n]
 
 
 class Profiler:

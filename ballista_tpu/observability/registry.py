@@ -15,10 +15,18 @@ Two sections:
 
 Kinds: ``counter`` (monotonic int, summed on merge), ``timer``
 (``elapsed_*`` seconds, summed on merge), ``gauge`` (last/max value,
-max-ed on merge).
+max-ed on merge), ``histogram`` (Prometheus cumulative-bucket
+histograms, observed through :func:`observe_histogram` in this module
+so the family gate covers them too).
 """
 
 from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Tuple
+
+log = logging.getLogger("ballista.health")
 
 # -- per-operator MetricsSet names -------------------------------------------
 
@@ -101,7 +109,73 @@ PROCESS_METRICS = {
                                                      "pool queue depth"),
     "ballista_executor_peak_host_bytes": ("gauge", "per-executor peak "
                                                    "tracked host bytes"),
+    # distributed profiler (scheduler)
+    "ballista_query_lane_seconds": ("histogram",
+                                    "per-query named wall-time lane "
+                                    "seconds (label lane=...), observed "
+                                    "when a merged profile artifact is "
+                                    "built for a job"),
+    "ballista_stage_seconds": ("histogram",
+                               "summed task seconds per completed stage "
+                               "(label stage=...), observed at job "
+                               "completion"),
 }
+
+# -- process-level histograms -------------------------------------------------
+# Cumulative-bucket histograms the health plane renders as
+# ``<family>_bucket{le=...}`` / ``_sum`` / ``_count``. One fixed bucket
+# ladder serves every family (they all measure seconds).
+
+HISTOGRAM_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                     60.0, 120.0)
+
+_hist_lock = threading.Lock()
+# family -> labelkey (sorted items tuple) -> [per-bucket counts, sum, n]
+_histograms: Dict[str, Dict[tuple, list]] = {}
+
+
+def observe_histogram(family: str, labels: Dict[str, str],
+                      value: float) -> None:
+    """Record one observation. The family must be registered in
+    PROCESS_METRICS with kind ``histogram`` — same gate the renderer
+    applies to counters/gauges."""
+    kind = PROCESS_METRICS.get(family, (None,))[0]
+    if kind != "histogram":
+        log.warning("dropping observation for unregistered histogram "
+                    "family %r (add it to observability/registry.py)",
+                    family)
+        return
+    key = tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
+    v = float(value)
+    with _hist_lock:
+        cells = _histograms.setdefault(family, {})
+        cell = cells.get(key)
+        if cell is None:
+            cell = cells[key] = [[0] * len(HISTOGRAM_BUCKETS), 0.0, 0]
+        counts, _, _ = cell
+        for i, le in enumerate(HISTOGRAM_BUCKETS):
+            if v <= le:
+                counts[i] += 1
+        cell[1] += v
+        cell[2] += 1
+
+
+def histogram_snapshot() -> Dict[str, List[Tuple[dict, list, float, int]]]:
+    """{family: [(labels, bucket counts, sum, count), ...]} — consumed
+    by the health plane's renderer."""
+    out: Dict[str, List[Tuple[dict, list, float, int]]] = {}
+    with _hist_lock:
+        for family, cells in _histograms.items():
+            rows = []
+            for key, (counts, total, n) in sorted(cells.items()):
+                rows.append((dict(key), list(counts), total, n))
+            out[family] = rows
+    return out
+
+
+def reset_histograms() -> None:
+    with _hist_lock:
+        _histograms.clear()
 
 
 def operator_metric_names() -> set:

@@ -34,6 +34,25 @@ dropped (never raising into the traced code). Writes are line-buffered
 under a process-local lock — tracing is for diagnosis runs, not the
 steady-state hot path, and the disabled path is a single cached boolean
 check.
+
+**Flight recorder**: independent of the trace FILE, every span/event
+record is also appended to a bounded in-memory ring (a deque of the
+most recent ``BALLISTA_FLIGHT_RECORDER_SPANS`` records, default 4096;
+``BALLISTA_FLIGHT_RECORDER=0`` disables). The ring is always on by
+default — it is what lets a query that crosses
+``BALLISTA_SLOW_QUERY_SECS`` dump a RETROACTIVE profile artifact, and
+what executors mine for the per-task profile windows shipped back with
+``CompletedTask`` (observability/distributed.py). Ring appends build
+the same record dict a file write would but skip the JSON encode and
+the lock, so the measured warm-query overhead stays under the 5% gate.
+
+**Process identity**: :func:`set_process_identity` stamps a role
+(``scheduler`` / ``executor``) and short executor id onto every record
+emitted by this process (``role`` / ``exec`` keys), so a merged
+multi-process artifact can place each record on the right process
+track. First writer wins — an in-process LocalCluster (scheduler and
+executors sharing one tracer) relies on per-task window extraction to
+re-tag executor records instead.
 """
 
 from __future__ import annotations
@@ -44,13 +63,17 @@ import os
 import tempfile
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 from typing import Optional
 
 _lock = threading.Lock()
-_state: dict = {"configured": False, "fh": None}
+_state: dict = {"configured": False, "fh": None, "ring": None}
 _span_ids = itertools.count(1)
 _tls = threading.local()
+# (role, short executor id) — set once per process; survives
+# reconfigure() (identity is who the process IS, not how it traces)
+_identity: dict = {}
 
 
 def _configure_locked() -> None:
@@ -59,6 +82,23 @@ def _configure_locked() -> None:
     # opens a window where a concurrent thread (ingest pipeline
     # producers trace from pool workers) reads fh=None and silently
     # drops its event
+    prev_ring = _state.pop("prev_ring", None)
+    if os.environ.get("BALLISTA_FLIGHT_RECORDER", "").lower() in (
+            "0", "off", "false"):
+        _state["ring"] = None
+    else:
+        try:
+            cap = int(os.environ.get("BALLISTA_FLIGHT_RECORDER_SPANS",
+                                     "4096"))
+        except ValueError:
+            cap = 4096
+        ring = deque(maxlen=max(cap, 16)) if cap > 0 else None
+        if ring is not None and prev_ring:
+            # the flight recorder survives trace-FILE reconfiguration
+            # (the profiler reconfigures at window start/stop; losing
+            # the ring there would blind the retroactive dump)
+            ring.extend(prev_ring)
+        _state["ring"] = ring
     if os.environ.get("BALLISTA_TRACE", "").lower() not in ("1", "on",
                                                             "true"):
         _state["fh"] = None
@@ -98,8 +138,30 @@ def _fh():
     return _state["fh"]
 
 
+def _ring():
+    if not _state["configured"]:
+        with _lock:
+            if not _state["configured"]:
+                _configure_locked()
+    return _state["ring"]
+
+
+def _recording() -> bool:
+    """True when spans must be materialized at all: a trace file is
+    open OR the flight-recorder ring is on."""
+    if not _state["configured"]:
+        with _lock:
+            if not _state["configured"]:
+                _configure_locked()
+    return _state["fh"] is not None or _state["ring"] is not None
+
+
 def trace_enabled() -> bool:
     return _fh() is not None
+
+
+def flight_recorder_enabled() -> bool:
+    return _ring() is not None
 
 
 def trace_path() -> Optional[str]:
@@ -116,8 +178,53 @@ def reconfigure() -> None:
                 fh.close()
             except OSError:
                 pass
+        ring = _state.get("ring")
         _state.clear()
-        _state.update({"configured": False, "fh": None})
+        _state.update({"configured": False, "fh": None, "ring": None,
+                       "prev_ring": ring})
+
+
+def set_process_identity(role: str, executor_id: Optional[str] = None
+                         ) -> None:
+    """Stamp this process's role (and short executor id) onto every
+    record emitted from now on. First writer wins: in an in-process
+    LocalCluster the scheduler and executors share one tracer, and
+    executor records are re-tagged at per-task window extraction
+    instead (observability/distributed.py)."""
+    if _identity:
+        return
+    _identity["role"] = role
+    if executor_id:
+        _identity["exec"] = executor_id[:8]
+
+
+def process_identity() -> dict:
+    return dict(_identity)
+
+
+def ring_records(since: Optional[float] = None,
+                 job: Optional[str] = None,
+                 task: Optional[str] = None) -> list:
+    """Snapshot of flight-recorder records, optionally filtered to those
+    OVERLAPPING ``since`` (a span started before but still running past
+    it counts) and/or carrying the given ``job``/``task`` flow attrs.
+    Returns the ring's record dicts — callers must copy before
+    mutating."""
+    ring = _ring()
+    if ring is None:
+        return []
+    out = []
+    for r in list(ring):
+        if since is not None and \
+                float(r.get("ts", 0.0)) + float(r.get("dur", 0.0)) < \
+                since - 1e-6:
+            continue
+        if job is not None and r.get("job") != job:
+            continue
+        if task is not None and r.get("task") != task:
+            continue
+        out.append(r)
+    return out
 
 
 # -- flow correlation ---------------------------------------------------------
@@ -154,6 +261,10 @@ def _span_stack() -> list:
 
 
 def _emit(record: dict) -> None:
+    ring = _ring()
+    if ring is not None:
+        # deque.append is atomic under the GIL; no lock, no JSON encode
+        ring.append(record)
     fh = _fh()
     if fh is None:
         return
@@ -182,6 +293,8 @@ def _emit(record: dict) -> None:
 def _base_record(name: str, attrs: dict) -> dict:
     rec = {"name": name, "ts": time.time(),
            "pid": os.getpid(), "tid": threading.get_ident()}
+    if _identity:
+        rec.update(_identity)
     fl = getattr(_tls, "flow", None)
     if fl:
         rec.update(fl)
@@ -192,7 +305,7 @@ def _base_record(name: str, attrs: dict) -> dict:
 def trace_event(name: str, **attrs) -> None:
     """Instant event (no duration). Carries the enclosing span's id as
     ``psid`` so it nests in the reconstructed tree."""
-    if _fh() is None:
+    if not _recording():
         return
     rec = _base_record(name, attrs)
     st = _span_stack()
@@ -214,7 +327,7 @@ class trace_span:
         self.attrs = attrs
 
     def __enter__(self):
-        if _fh() is None:
+        if not _recording():
             self._t0 = None
             return self
         self._t0 = time.time()

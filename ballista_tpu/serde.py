@@ -752,3 +752,57 @@ def stage_metrics_from_proto(msgs) -> Dict[int, dict]:
             "operators": [], "elapsed_total": 0.0}
         out[sm.stage_id] = {"num_tasks": sm.num_tasks or 1, **tm}
     return out
+
+
+# -- distributed profiler: per-task profile windows ---------------------------
+# Python shape (observability/distributed.capture_task_profile):
+# {"t0", "wall_seconds", "pid", "role", "executor_id",
+#  "records": [span dict...], "phases": {...}, "compile": {...},
+#  "memory": {...}}. Records/context dicts are free-form span attrs, so
+# they cross the wire as JSON blobs.
+
+
+def task_profile_to_proto(p: dict, msg: "pb.TaskProfile") -> None:
+    import json
+
+    msg.t0 = float(p.get("t0", 0.0))
+    msg.wall_seconds = float(p.get("wall_seconds", 0.0))
+    msg.pid = int(p.get("pid", 0))
+    msg.role = str(p.get("role", "executor"))
+    msg.executor_id = str(p.get("executor_id", ""))
+    # capture_task_profile pre-encodes the record list while applying
+    # its byte bound — reuse that instead of serializing twice
+    pre = p.get("records_json")
+    msg.records_json = pre.encode() if isinstance(pre, str) else \
+        json.dumps(p.get("records") or [], default=str).encode()
+    msg.phases_json = json.dumps(p.get("phases") or {},
+                                 default=str).encode()
+    msg.compile_json = json.dumps(p.get("compile") or {},
+                                  default=str).encode()
+    msg.memory_json = json.dumps(p.get("memory") or {},
+                                 default=str).encode()
+
+
+def task_profile_from_proto(msg: "pb.TaskProfile") -> Optional[dict]:
+    import json
+
+    if not msg.records_json and not msg.wall_seconds:
+        return None
+
+    def _load(raw, default):
+        try:
+            return json.loads(raw.decode()) if raw else default
+        except (ValueError, UnicodeDecodeError):
+            return default
+
+    return {
+        "t0": msg.t0,
+        "wall_seconds": msg.wall_seconds,
+        "pid": msg.pid,
+        "role": msg.role or "executor",
+        "executor_id": msg.executor_id,
+        "records": _load(msg.records_json, []),
+        "phases": _load(msg.phases_json, {}),
+        "compile": _load(msg.compile_json, {}),
+        "memory": _load(msg.memory_json, {}),
+    }

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Compare two bench.py JSON lines and fail on regression.
+
+Intended invocation — OLD is the accepted baseline round, NEW is the
+candidate (each file holds one or more JSON lines as bench.py prints
+them; the LAST well-formed line wins, matching the parent watchdog's
+salvage rule):
+
+    python dev/check_bench_regress.py BENCH_r05.json BENCH_r06.json
+
+Exit codes: 0 = no regression, 1 = at least one metric regressed past
+its tolerance, 2 = usage / unreadable input. Each checked metric prints
+one line (`ok` / `REGRESSED` / `skipped` when either side lacks it), so
+a red run says exactly which lane or latency moved.
+
+Per-metric tolerances are deliberately loose: bench runs on a noisy
+shared box (the repo's measured run-to-run jitter on cold phases is
+tens of percent), so only moves beyond the listed relative slack fail.
+Scale them all at once with ``--tolerance-scale`` (e.g. 2.0 on a
+particularly noisy box). Metrics the profiler added in PR 7
+(``device_blocked_seconds`` / ``host_dictionary_seconds`` /
+``compile_trace_lower_seconds``) make ROADMAP's lane-cited targets
+(e.g. item 2's host_dictionary < 0.5s) regression-checkable from bench
+output alone.
+
+``--self-test`` runs the built-in check of the comparison logic
+(tier-1 invokes it from tests/test_distributed_profiler.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Optional, Tuple
+
+# metric -> (direction, relative tolerance). "lower" = lower is better.
+METRICS: Dict[str, Tuple[str, float]] = {
+    # headline throughput (rows/s, higher is better)
+    "value": ("higher", 0.25),
+    # latencies (seconds, lower is better)
+    "warm_seconds": ("lower", 0.25),
+    "cold_seconds": ("lower", 0.35),
+    "first_run_seconds": ("lower", 0.35),
+    "q5_first_seconds": ("lower", 0.35),
+    "q5_warm_seconds": ("lower", 0.30),
+    "q16_first_seconds": ("lower", 0.35),
+    "q16_warm_seconds": ("lower", 0.30),
+    # profiler lanes (PR 7): the ROADMAP's lane-cited targets
+    "device_blocked_seconds": ("lower", 0.45),
+    "host_dictionary_seconds": ("lower", 0.45),
+    "compile_trace_lower_seconds": ("lower", 0.45),
+    # resource envelope
+    "peak_rss_mb": ("lower", 0.30),
+}
+
+
+def last_json_line(path: str) -> Optional[dict]:
+    """The bench line in the file. Accepts both raw bench.py output
+    (JSON lines; the LAST well-formed one wins — bench prints partial
+    snapshots first, and the watchdog salvages the same way) and the
+    driver's archived wrapper format (BENCH_rNN.json: one pretty-printed
+    object with the bench line under ``parsed``)."""
+    try:
+        text = open(path).read()
+    except OSError as e:
+        print(f"error: cannot read {path}: {e}", file=sys.stderr)
+        return None
+    try:
+        whole = json.loads(text)
+        if isinstance(whole, dict):
+            if isinstance(whole.get("parsed"), dict):
+                return whole["parsed"]
+            return whole
+    except ValueError:
+        pass
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    print(f"error: no JSON line in {path}", file=sys.stderr)
+    return None
+
+
+def compare(old: dict, new: dict, tolerance_scale: float = 1.0) -> list:
+    """Returns [(metric, old, new, rel_change, regressed, checked)].
+    ``rel_change`` is signed so the report reads naturally: positive =
+    the metric moved in the WORSE direction."""
+    rows = []
+    for metric, (direction, tol) in METRICS.items():
+        if metric not in old or metric not in new:
+            rows.append((metric, old.get(metric), new.get(metric),
+                         None, False, False))
+            continue
+        o, n = float(old[metric]), float(new[metric])
+        if o <= 0:
+            rows.append((metric, o, n, None, False, False))
+            continue
+        if direction == "lower":
+            rel = (n - o) / o  # got slower/bigger = worse
+        else:
+            rel = (o - n) / o  # got smaller = worse
+        regressed = rel > tol * tolerance_scale
+        rows.append((metric, o, n, rel, regressed, True))
+    return rows
+
+
+def report(rows, tolerance_scale: float) -> int:
+    bad = 0
+    for metric, o, n, rel, regressed, checked in rows:
+        if not checked:
+            print(f"skipped    {metric}: missing on one side "
+                  f"(old={o!r} new={n!r})")
+            continue
+        direction, tol = METRICS[metric]
+        tol *= tolerance_scale
+        tag = "REGRESSED" if regressed else "ok"
+        if regressed:
+            bad += 1
+        print(f"{tag:<10} {metric}: {o:g} -> {n:g} "
+              f"({rel:+.1%} worse-direction, tol {tol:.0%}, "
+              f"{direction} is better)")
+    if bad:
+        print(f"{bad} metric(s) regressed past tolerance",
+              file=sys.stderr)
+    return 1 if bad else 0
+
+
+def self_test() -> int:
+    """Pin the comparison semantics this script promises."""
+    old = {"value": 1000.0, "warm_seconds": 1.0,
+           "host_dictionary_seconds": 2.0, "peak_rss_mb": 1000.0}
+    # within tolerance: slightly slower warm, slightly lower throughput
+    ok_new = {"value": 900.0, "warm_seconds": 1.1,
+              "host_dictionary_seconds": 1.0, "peak_rss_mb": 1100.0}
+    rows = compare(old, ok_new)
+    assert not any(r[4] for r in rows), rows
+    # a big warm slowdown regresses; an IMPROVEMENT never does
+    bad_new = {"value": 5000.0, "warm_seconds": 2.0}
+    rows = {r[0]: r for r in compare(old, bad_new)}
+    assert rows["warm_seconds"][4] is True
+    assert rows["value"][4] is False
+    # higher-is-better: a big throughput drop regresses
+    rows = {r[0]: r for r in compare(old, {"value": 500.0})}
+    assert rows["value"][4] is True
+    # missing metrics are skipped, never failed
+    assert all(not r[4] for r in compare(old, {}))
+    # tolerance scaling loosens the gate
+    rows = {r[0]: r for r in compare(old, {"warm_seconds": 1.4},
+                                     tolerance_scale=2.0)}
+    assert rows["warm_seconds"][4] is False
+    # zero/absent baselines are skipped (cannot compute a ratio)
+    assert not any(r[4] for r in compare({"value": 0.0},
+                                         {"value": 10.0}))
+    print("self-test ok")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="compare two bench.py JSON files; non-zero exit on "
+                    "regression")
+    ap.add_argument("old", nargs="?", help="baseline bench JSON file")
+    ap.add_argument("new", nargs="?", help="candidate bench JSON file")
+    ap.add_argument("--tolerance-scale", type=float, default=1.0,
+                    help="multiply every per-metric tolerance "
+                         "(noisy boxes: try 2.0)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the built-in comparison-logic checks")
+    args = ap.parse_args()
+    if args.self_test:
+        return self_test()
+    if not args.old or not args.new:
+        ap.print_usage(sys.stderr)
+        return 2
+    old = last_json_line(args.old)
+    new = last_json_line(args.new)
+    if old is None or new is None:
+        return 2
+    return report(compare(old, new, args.tolerance_scale),
+                  args.tolerance_scale)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

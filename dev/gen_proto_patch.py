@@ -174,6 +174,34 @@ def apply_health(fdp: dp.FileDescriptorProto) -> None:
               F.TYPE_MESSAGE, type_name=".ballista_tpu.ExecutorResources")
 
 
+def apply_profiler(fdp: dp.FileDescriptorProto) -> None:
+    """PR 7: distributed profiler wire fields (mirrored by hand in
+    ballista.proto; dev/check_proto_sync.py guards the drift) — the
+    per-task profile window riding CompletedTask, and the GetJobProfile
+    RPC messages serving merged per-job artifacts to clients."""
+    if not has_message(fdp, "TaskProfile"):
+        m = fdp.message_type.add(name="TaskProfile")
+        add_field(m, "t0", 1, F.TYPE_DOUBLE)
+        add_field(m, "wall_seconds", 2, F.TYPE_DOUBLE)
+        add_field(m, "pid", 3, F.TYPE_UINT32)
+        add_field(m, "role", 4, F.TYPE_STRING)
+        add_field(m, "executor_id", 5, F.TYPE_STRING)
+        add_field(m, "records_json", 6, F.TYPE_BYTES)
+        add_field(m, "phases_json", 7, F.TYPE_BYTES)
+        add_field(m, "compile_json", 8, F.TYPE_BYTES)
+        add_field(m, "memory_json", 9, F.TYPE_BYTES)
+    add_field(get_message(fdp, "CompletedTask"), "profile", 5,
+              F.TYPE_MESSAGE, type_name=".ballista_tpu.TaskProfile")
+
+    if not has_message(fdp, "GetJobProfileParams"):
+        m = fdp.message_type.add(name="GetJobProfileParams")
+        add_field(m, "job_id", 1, F.TYPE_STRING)
+    if not has_message(fdp, "GetJobProfileResult"):
+        m = fdp.message_type.add(name="GetJobProfileResult")
+        add_field(m, "artifact_json", 1, F.TYPE_BYTES)
+        add_field(m, "error", 2, F.TYPE_STRING)
+
+
 TEMPLATE = '''# -*- coding: utf-8 -*-
 # Generated by dev/gen_proto_patch.py (no protoc in this image). DO NOT EDIT!
 # source: ballista.proto
@@ -203,6 +231,7 @@ def main() -> None:
     apply_observability(fdp)
     apply_adaptive(fdp)
     apply_health(fdp)
+    apply_profiler(fdp)
     out = TEMPLATE.format(blob=fdp.SerializeToString())
     with open(PB2, "w") as f:
         f.write(out)

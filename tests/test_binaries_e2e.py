@@ -1,12 +1,18 @@
 """Process-level integration: the real scheduler and executor BINARIES
 (separate processes, real gRPC control plane, real socket data plane)
 serve a SQL query end to end — the role docker-compose integration
-plays for the reference (dev/integration-tests.sh), without docker."""
+plays for the reference (dev/integration-tests.sh), without docker.
+With ``BALLISTA_PROFILE`` on the scheduler the run also gates the
+distributed profiler: executors ship per-task profile windows over the
+wire and the scheduler merges them into one Chrome-trace artifact with
+a REAL process track per executor pid."""
 
+import json
 import os
 import re
 import signal
 import subprocess
+import time
 
 import numpy as np
 import pytest
@@ -30,10 +36,17 @@ def test_binaries_end_to_end(tmp_path):
     repo = os.path.join(os.path.dirname(__file__), "..")
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
 
+    # distributed profiler: the scheduler merges its own spans with the
+    # task profile windows the executor binaries ship over the wire
+    profile_dir = tmp_path / "profiles"
+    sched_env = dict(env)
+    sched_env["BALLISTA_PROFILE"] = str(profile_dir)
+
     procs = []
     try:
         sched = _spawn(["ballista_tpu.distributed.scheduler_main",
-                        "--bind-host", "localhost", "--port", "0"], env)
+                        "--bind-host", "localhost", "--port", "0"],
+                       sched_env)
         procs.append(sched)
         line = sched.wait_for(lambda ln: "listening on" in ln)
         m = re.search(r"listening on [^:]+:(\d+)", line)
@@ -49,14 +62,21 @@ def test_binaries_end_to_end(tmp_path):
                         "--scheduler-host", "localhost",
                         "--scheduler-port", str(port),
                         "--work-dir", str(tmp_path / f"w{i}"),
+                        "--concurrent-tasks", "1",
                         "--num-devices", "1"], env)
             procs.append(e)
             exec_health.append(_health_port(e))
         for hp in exec_health:
             assert wait_healthz(hp)["role"] == "executor"
 
-        data = tmp_path / "t.tbl"
-        data.write_text("".join(f"{i}|k{i % 3}|\n" for i in range(90)))
+        # a DIRECTORY of part files -> multi-partition scan stage, so
+        # with one task slot per executor both executors serve tasks
+        data = tmp_path / "t"
+        data.mkdir()
+        for p in range(6):
+            (data / f"part-{p}.tbl").write_text(
+                "".join(f"{i}|k{i % 3}|\n"
+                        for i in range(p * 15, (p + 1) * 15)))
 
         from ballista_tpu.client import BallistaContext
         from ballista_tpu.io import TblSource
@@ -81,6 +101,41 @@ def test_binaries_end_to_end(tmp_path):
         assert "ballista_executors_live 2" in text
         assert "ballista_jobs_completed_total 1" in text
         assert "ballista_executor_rss_bytes{" in text
+
+        # merged per-job artifact: one file, valid Chrome-trace JSON,
+        # with the scheduler track and BOTH executor processes (real
+        # distinct pids) as their own tracks, task flow arrows included.
+        # Job completion is published to the client BEFORE the
+        # scheduler's terminal hook writes the artifact — poll briefly.
+        deadline = time.time() + 30
+        files = []
+        while time.time() < deadline and not files:
+            files = list(profile_dir.glob("ballista-profile-job-*.json"))
+            if not files:
+                time.sleep(0.2)
+        assert len(files) == 1, files
+        art = json.load(open(files[0]))
+        assert art["traceEvents"] and art.get("displayTimeUnit") == "ms"
+        tracks = [ev["args"]["name"] for ev in art["traceEvents"]
+                  if ev.get("ph") == "M" and ev["name"] == "process_name"]
+        assert any(t.startswith("scheduler") for t in tracks), tracks
+        exec_tracks = [t for t in tracks if t.startswith("executor ")]
+        assert len(exec_tracks) >= 2, tracks
+        # distinct OS pids on the executor tracks (real processes)
+        exec_pids = {re.search(r"pid (\d+)", t).group(1)
+                     for t in exec_tracks}
+        assert len(exec_pids) >= 2, tracks
+        assert any(ev.get("ph") == "s" for ev in art["traceEvents"])
+        assert set(art["lanes"]) and art["wall_seconds"] > 0
+        # /debug/profile/<job_id> serves the same artifact from the
+        # scheduler binary's health plane
+        dbg = json.loads(http_get(sched_health, "/debug/queries"))
+        job_entries = [q for q in dbg["queries"] if "job_id" in q]
+        assert job_entries and job_entries[-1].get("plan_digest")
+        served = json.loads(http_get(
+            sched_health, f"/debug/profile/{job_entries[-1]['job_id']}"))
+        assert served["distributed"]["job_id"] == \
+            job_entries[-1]["job_id"]
     finally:
         for p in procs:
             p.send_signal(signal.SIGTERM)

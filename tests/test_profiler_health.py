@@ -64,7 +64,7 @@ def clean_env():
 # ---------------------------------------------------------------------------
 
 
-_VALID_PH = {"X", "i", "M"}
+_VALID_PH = {"X", "i", "M", "s", "f"}
 
 
 def _validate_chrome_trace(art: dict) -> None:
@@ -82,8 +82,12 @@ def _validate_chrome_trace(art: dict) -> None:
         elif ev["ph"] == "i":
             assert ev.get("s") in ("t", "p", "g")
         elif ev["ph"] == "M":
-            assert ev["name"] in ("process_name", "thread_name")
-            assert "name" in ev["args"]
+            assert ev["name"] in ("process_name", "thread_name",
+                                  "process_sort_index")
+            assert ev["args"], ev
+        elif ev["ph"] in ("s", "f"):
+            # flow arrows: must carry an id and bind to a timestamp
+            assert "id" in ev and isinstance(ev["ts"], (int, float))
     assert art.get("displayTimeUnit") in ("ms", "ns")
 
 
