@@ -166,8 +166,31 @@ class BallistaContext:
         src = ParquetSource(path, schema, **kw)
         return DataFrame(self, TableScan("parquet:" + path, src))
 
+    def _system_source(self, name: str):
+        """Scan source for a ``system.*`` table: the current process's
+        snapshot in standalone mode; in remote mode rows are fetched
+        from the SCHEDULER at scan/ship time so they reflect cluster
+        state (observability/systables.py)."""
+        from .observability.systables import SystemTableSource
+
+        if self.mode == "remote":
+            host, port = self.host, self.port
+
+            def fetch():
+                from .distributed.client import fetch_system_table
+
+                return fetch_system_table(host, port, name)
+
+            return SystemTableSource(name, fetcher=fetch)
+        return SystemTableSource(name)
+
     def table(self, name: str) -> "DataFrame":
         if name not in self._catalog:
+            from .observability.systables import is_system_table
+
+            if is_system_table(name):
+                return DataFrame(
+                    self, TableScan(name, self._system_source(name)))
             raise PlanError(f"unknown table {name!r}")
         t = self._catalog[name]
         if t.plan is not None:  # registered DataFrame view: inline a
@@ -204,9 +227,16 @@ class BallistaContext:
             else:
                 raise PlanError(f"STORED AS {stmt.stored_as} unsupported")
             return DataFrame(self, None)
-        planner = SqlPlanner(self._catalog)
+        planner = SqlPlanner(self._catalog,
+                             system_provider=self._system_source)
         df = DataFrame(self, planner.plan(stmt))
-        self._plan_cache[query] = df
+        # plans over system.* tables are NOT cached: a cached plan reuses
+        # its physical operator instances, whose materializations (a
+        # JoinExec build side, RepartitionExec parts) would freeze the
+        # telemetry snapshot of the FIRST collect — re-issuing the SQL
+        # must see fresh rows (observability/systables.py)
+        if not _scans_system_table(df._plan):
+            self._plan_cache[query] = df
         return df
 
     # -- execution ----------------------------------------------------------
@@ -231,7 +261,24 @@ class BallistaContext:
         passes a cached physical plan), execute, record metrics.
         Returns ``(frame, phys)`` so DataFrame.collect can keep its
         plan cache. Under ``BALLISTA_PROFILE=<dir>`` every collect
-        writes a Chrome-trace profile artifact into the directory."""
+        writes a Chrome-trace profile artifact into the directory.
+        Every collect's terminal summary (status, wall seconds, output
+        rows, flight-recorder lanes, artifact path) lands in the shared
+        system-tables snapshot + the durable query-history log
+        (observability/systables.py) — the standalone face of the
+        scheduler's terminal-transition hook."""
+        from .observability.systables import StandaloneQueryRecorder
+
+        rec = StandaloneQueryRecorder(plan)
+        try:
+            out, phys2 = self._standalone_collect_routed(plan, phys, rec)
+        except Exception as e:  # noqa: BLE001 - record, then propagate
+            rec.finish("failed", error=e)
+            raise
+        rec.finish("completed", result=out, phys=phys2)
+        return out, phys2
+
+    def _standalone_collect_routed(self, plan: LogicalPlan, phys, rec):
         from .observability import profiler as obs_profiler
 
         out_dir = obs_profiler.profile_dir()
@@ -268,6 +315,7 @@ class BallistaContext:
                 path = None
             if path is not None:
                 plog.info("profile artifact written: %s", path)
+                rec.artifact_path = path
             return box["r"]
         # unprofiled run: the always-on flight recorder still lets a
         # query that crosses BALLISTA_SLOW_QUERY_SECS dump a RETROACTIVE
@@ -277,8 +325,13 @@ class BallistaContext:
         def slow_label():
             return "query-" + obs_profiler.plan_digest(plan)
 
-        with watch_slow_query(slow_label):
-            return self._standalone_collect_inner(plan, phys)
+        slow_sink: list = []
+        try:
+            with watch_slow_query(slow_label, artifact_out=slow_sink):
+                return self._standalone_collect_inner(plan, phys)
+        finally:
+            if slow_sink:
+                rec.artifact_path = slow_sink[0]
 
     def _standalone_collect_inner(self, plan: LogicalPlan, phys=None):
         import pandas as pd
@@ -397,6 +450,17 @@ def _is_ddl(query: str) -> bool:
     return query.lstrip().lower().startswith("create")
 
 
+def _scans_system_table(plan: Optional[LogicalPlan]) -> bool:
+    from .observability.systables import SystemTableSource
+
+    if plan is None:
+        return False
+    if isinstance(plan, TableScan) and \
+            isinstance(plan.source, SystemTableSource):
+        return True
+    return any(_scans_system_table(c) for c in plan.children())
+
+
 class DataFrame:
     """Lazy relational frame over a logical plan (reference:
     BallistaDataFrame, rust/client/src/context.rs:149-315)."""
@@ -420,7 +484,8 @@ class DataFrame:
             # server-planned frame used through the DataFrame API (schema,
             # verbs, count...): plan locally on demand; collect() still
             # takes the raw-SQL path
-            planner = SqlPlanner(self.ctx._catalog)
+            planner = SqlPlanner(self.ctx._catalog,
+                                 system_provider=self.ctx._system_source)
             self._plan = planner.plan(parse_sql(self._raw_sql))
         if self._plan is None:
             raise PlanError("this DataFrame carries no plan (DDL result)")

@@ -413,6 +413,33 @@ class CompileGovernor:
         with self._lock:
             return sum(len(s) for s in self._spaces.values())
 
+    def entry_rows(self) -> list:
+        """Per-entry accounting rows for ``system.compile``: signature,
+        call/compile counts, elapsed compile seconds, persistent-cache
+        hits, AOT loads. Snapshot under the lock; rendering outside."""
+        with self._lock:
+            snap = [(ns, gf) for ns, space in self._spaces.items()
+                    for gf in space.values()]
+        out = []
+        for ns, gf in snap:
+            aot_loads = 0
+            if gf.aot is not None:
+                # list() first: a concurrent query may be inserting a
+                # freshly-loaded artifact under the entry lock, which
+                # this read does not take
+                aot_loads = sum(1 for v in list(gf.aot.loaded.values())
+                                if v is not None)
+            out.append({
+                "namespace": ns,
+                "signature": _render_key(gf.key),
+                "calls": gf.calls,
+                "compiles": gf.compiles,
+                "compile_seconds": round(gf.compile_seconds, 6),
+                "persistent_cache_hits": gf.pcache_hits,
+                "aot_loads": aot_loads,
+            })
+        return out
+
     def namespace_sizes(self) -> Dict[str, int]:
         with self._lock:
             return {ns: len(s) for ns, s in self._spaces.items()}

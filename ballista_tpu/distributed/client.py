@@ -191,6 +191,22 @@ def fetch_job_profile(host: str, port: int, job_id: str,
     return json.loads(res.artifact_json.decode())
 
 
+def fetch_system_table(host: str, port: int, table: str) -> list:
+    """Fetch one system.* table's rows from the scheduler's snapshot
+    (GetSystemTable RPC) — what a remote context's system-table scans
+    read, so they see cluster state instead of the client process."""
+    import json
+
+    client = SchedulerClient(host, port)
+    try:
+        res = client.GetSystemTable(pb.GetSystemTableParams(table=table))
+    finally:
+        client.close()
+    if res.error:
+        raise ClusterError(res.error)
+    return json.loads(res.rows_json.decode())
+
+
 def _deliver_metrics(result: pb.GetJobStatusResult,
                      metrics_out: Optional[list]) -> None:
     if metrics_out is None:

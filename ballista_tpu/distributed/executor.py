@@ -304,16 +304,22 @@ class Executor:
                 self._report_completed(pid, stats, td.stage_version,
                                        profile=profile)
                 self.tasks_completed += 1
+                # same shape as the scheduler's query ring entries
+                # (status/wall_seconds/output_rows — the systables
+                # record contract), "rows"/"state" kept as legacy keys
                 self._query_log.record({
                     "task": pid.key(), "state": "completed",
+                    "status": "completed",
                     "wall_seconds": round(time.time() - t0, 4),
                     "rows": int(stats.get("num_rows", 0)),
+                    "output_rows": int(stats.get("num_rows", 0)),
                 })
             except Exception as e:  # noqa: BLE001 - task failure
                 log.exception("task %s failed", pid)
                 self.tasks_failed += 1
                 self._query_log.record({
                     "task": pid.key(), "state": "failed",
+                    "status": "failed",
                     "wall_seconds": round(time.time() - t0, 4),
                     "error": f"{type(e).__name__}: {e}"[:300],
                 })

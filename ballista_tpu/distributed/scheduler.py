@@ -276,6 +276,17 @@ class SchedulerService:
         from ..observability.health import (maybe_start_health_server,
                                             metrics_port_from_env)
 
+        # system.* tables (observability/systables.py): the scheduler
+        # owns the cluster-wide snapshot — query ring + durable
+        # history, per-job operator metrics, executor heartbeats — and
+        # serves it to remote scans over GetSystemTable
+        from ..observability.systables import OperatorStore, SystemSnapshot
+
+        self.system_ops = OperatorStore()
+        self.systables = SystemSnapshot(
+            query_log=state.query_log, operators=self.system_ops,
+            executors_fn=self._executor_rows,
+        )
         self.tasks_dispatched = 0
         if metrics_port is None:
             metrics_port = metrics_port_from_env(-1)
@@ -316,6 +327,25 @@ class SchedulerService:
                         res.get("peak_host_bytes", 0)))
         return out
 
+    def _executor_rows(self):
+        """system.executors rows from the executor heartbeat metadata
+        (same source as the /metrics per-executor gauges)."""
+        rows = []
+        for m in self.state.get_executors_metadata():
+            res = getattr(m, "resources", None) or {}
+            rows.append({
+                "executor_id": m.id,
+                "host": m.host,
+                "port": m.port,
+                "num_devices": m.num_devices or 1,
+                "rss_bytes": res.get("rss_bytes"),
+                "device_bytes": res.get("device_bytes"),
+                "inflight_tasks": res.get("inflight_tasks"),
+                "ingest_pool_depth": res.get("ingest_pool_depth"),
+                "peak_host_bytes": res.get("peak_host_bytes"),
+            })
+        return rows
+
     def close_health(self):
         if self.health is not None:
             self.health.close()
@@ -335,55 +365,84 @@ class SchedulerService:
         merge/render/write is handed to a single background worker —
         a multi-megabyte artifact must not stall task handout."""
         from ..observability import profiler as obs_profiler
-        from ..observability import tracing
+        from ..observability import systables, tracing
         from ..observability.distributed import slow_query_dir
         from ..observability.health import slow_query_secs
         from ..observability.registry import observe_histogram
 
         self.profiles.finalize(job_id, summary)
-        for sid, sm in (getattr(status, "stage_metrics", None) or {}).items():
+        sm = getattr(status, "stage_metrics", None) or {}
+        for sid, stage in sm.items():
             observe_histogram("ballista_stage_seconds",
                               {"stage": str(sid)},
-                              float(sm.get("elapsed_total", 0.0)))
+                              float(stage.get("elapsed_total", 0.0)))
+        if sm:
+            # system.operators: the job's per-stage operator metrics
+            # (already aggregated host data — a cheap materialization)
+            self.system_ops.record(job_id,
+                                   summary.get("plan_digest") or "",
+                                   systables.stage_metrics_provider(sm))
         thr = slow_query_secs()
         slow = thr is not None and \
             float(summary.get("wall_seconds", 0.0)) >= thr
         out_dir = obs_profiler.profile_dir()
-        if out_dir is None and not slow:
-            return
         # snapshot the scheduler's ring window NOW: by the time the
         # worker runs, later queries may have evicted this job's spans
         sched_records = tracing.ring_records(job=job_id)
         wall = float(summary.get("wall_seconds", 0.0))
         dest = out_dir if out_dir is not None else slow_query_dir()
+        want_artifact = out_dir is not None or slow
 
         def build_and_write():
+            # EVERY job gets its lane decomposition (system.query_lanes
+            # + the lane histograms); the merged ARTIFACT is still only
+            # rendered/written when profiled or slow. Runs here, off
+            # the PollWork handler thread — the merge walks every
+            # collected task window.
             try:
-                art = self.profiles.build(job_id, wall_seconds=wall,
-                                          sched_records=sched_records)
-                if art is None:
-                    return
-                for lane, secs in (art.get("lanes") or {}).items():
+                art = path = None
+                if want_artifact:
+                    art = self.profiles.build(job_id, wall_seconds=wall,
+                                              sched_records=sched_records)
+                if art is not None:
+                    lanes = dict(art.get("lanes") or {})
+                else:
+                    from ..observability.distributed import merged_session
+                    from ..observability.export import compute_lanes
+
+                    session = merged_session(
+                        job_id, sched_records,
+                        self.profiles.task_payloads(job_id), wall)
+                    lanes = compute_lanes(session)["lanes"]
+                for lane, secs in lanes.items():
                     observe_histogram("ballista_query_lane_seconds",
                                       {"lane": lane}, float(secs))
-                from ..observability.export import write_artifact_file
+                if art is not None:
+                    from ..observability.export import write_artifact_file
 
-                try:
-                    path = write_artifact_file(art, out_dir=dest)
-                except OSError:
-                    log.exception("profile artifact write failed for "
-                                  "job %s", job_id)
-                    return
-                self.profiles.set_artifact(job_id, art, path)
+                    try:
+                        path = write_artifact_file(art, out_dir=dest)
+                    except OSError:
+                        log.exception("profile artifact write failed "
+                                      "for job %s", job_id)
+                        path = None
+                    else:
+                        self.profiles.set_artifact(job_id, art, path)
+                        log.info("merged profile artifact for job %s: "
+                                 "%s", job_id, path)
                 # the ring records the summary BY COPY at the terminal
                 # transition, usually before this build finishes: set
                 # the source dict (covers a build outrunning record)
-                # AND annotate the recorded entries (the common case)
-                summary["profile_artifact"] = path
-                self.state.query_log.annotate(job_id,
-                                              profile_artifact=path)
-                log.info("merged profile artifact for job %s: %s",
-                         job_id, path)
+                # AND annotate the recorded entries + history log (the
+                # common case)
+                fields = {"lanes": lanes}
+                summary["lanes"] = lanes
+                if path is not None:
+                    summary["profile_artifact"] = path
+                    fields["profile_artifact"] = path
+                systables.annotate_query(job_id,
+                                         query_log=self.state.query_log,
+                                         **fields)
             except Exception:  # noqa: BLE001 - observability only
                 log.exception("profile build failed for job %s", job_id)
 
@@ -433,7 +492,18 @@ class SchedulerService:
                 "CREATE EXTERNAL TABLE is a client-side statement; the "
                 "scheduler keeps no durable catalog"
             )
-        return SqlPlanner(catalog).plan(stmt)
+
+        def system_source(name):
+            # server-planned SQL over system.* tables: materialize the
+            # SCHEDULER's snapshot at plan time (executors scan the
+            # shipped rows)
+            from ..observability.systables import SystemTableSource
+
+            return SystemTableSource(
+                name, rows=self.systables.table_rows(name))
+
+        return SqlPlanner(catalog,
+                          system_provider=system_source).plan(stmt)
 
     def _plan_job(self, job_id: str, logical_plan, settings=None,
                   sql=None, catalog_entries=None):
@@ -701,6 +771,28 @@ class SchedulerService:
             result.artifact_json = _json.dumps(art, default=str).encode()
         return result
 
+    # -- RPC: GetSystemTable -------------------------------------------------
+
+    def GetSystemTable(self, request: pb.GetSystemTableParams,
+                       context=None):
+        """Serve one system.* table's rows from the SCHEDULER's
+        snapshot: remote contexts route their system-table scans here
+        so ``system.executors`` / ``system.queries`` reflect cluster
+        state, not the client process."""
+        import json as _json
+
+        result = pb.GetSystemTableResult()
+        try:
+            rows = self.systables.table_rows(request.table)
+        except KeyError as e:
+            result.error = str(e)
+        except Exception as e:  # noqa: BLE001 - diagnosis plane
+            log.exception("system table build failed: %s", request.table)
+            result.error = f"{type(e).__name__}: {e}"
+        else:
+            result.rows_json = _json.dumps(rows, default=str).encode()
+        return result
+
     # -- RPC: GetExecutorsMetadata ------------------------------------------
 
     def GetExecutorsMetadata(self, request, context=None):
@@ -805,6 +897,7 @@ _RPCS = {
     "PollWork": (pb.PollWorkParams, pb.PollWorkResult),
     "GetJobStatus": (pb.GetJobStatusParams, pb.GetJobStatusResult),
     "GetJobProfile": (pb.GetJobProfileParams, pb.GetJobProfileResult),
+    "GetSystemTable": (pb.GetSystemTableParams, pb.GetSystemTableResult),
     "GetExecutorsMetadata": (
         pb.GetExecutorsMetadataParams, pb.GetExecutorsMetadataResult,
     ),

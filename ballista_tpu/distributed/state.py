@@ -305,23 +305,32 @@ class SchedulerState:
                     self.jobs_completed += 1
                 else:
                     self.jobs_failed += 1
-                summary = {
-                    "job_id": job_id,
-                    "state": status.state,
-                    "wall_seconds": round(time.time() - t0, 4),
-                    "num_stages": len(self.stage_ids(job_id)),
-                }
-                # pop: the digest's job is done (the summary carries it
-                # on), and the dict must not grow one entry per job for
-                # the scheduler's lifetime
-                digest = self._job_digests.pop(job_id, None)
-                if digest:
-                    # a slow query must be diagnosable after the fact:
-                    # the plan digest identifies WHAT ran without
-                    # re-planning it
-                    summary["plan_digest"] = digest
-                if status.error:
-                    summary["error"] = str(status.error)[:300]
+                # ONE record shape for every surface (/debug/queries,
+                # the durable history log, system.queries): built by
+                # the shared systables layer so they cannot drift
+                from ..observability import systables
+
+                out_rows = None
+                sm = getattr(status, "stage_metrics", None)
+                if sm:
+                    try:
+                        from ..observability.metrics import QueryMetrics
+
+                        out_rows = QueryMetrics(sm).total_output_rows()
+                    except Exception:  # noqa: BLE001 - advisory
+                        out_rows = None
+                summary = systables.build_query_record(
+                    job_id, status.state, time.time() - t0,
+                    # pop: the digest's job is done (the summary
+                    # carries it on), and the dict must not grow one
+                    # entry per job for the scheduler's lifetime
+                    plan_digest=self._job_digests.pop(job_id, None),
+                    output_rows=out_rows,
+                    num_stages=len(self.stage_ids(job_id)),
+                    started_at=t0,
+                    error=status.error,
+                    origin="cluster",
+                )
                 if self.profile_hook is not None:
                     # runs ONCE per job (t0 was just popped); may build
                     # the merged profile artifact and attach its path to
@@ -332,7 +341,8 @@ class SchedulerState:
                     except Exception:  # noqa: BLE001
                         log.exception("profile hook failed for job %s",
                                       job_id)
-                self.query_log.record(summary)
+                systables.record_query(summary,
+                                       query_log=self.query_log)
 
     def get_job_status(self, job_id: str) -> Optional[JobStatus]:
         v = self.kv.get(self._k("jobs", job_id))

@@ -44,3 +44,11 @@ from .health import (  # noqa: F401
     render_prometheus,
 )
 from .profiler import Profiler, profile_call, profile_dir  # noqa: F401
+from .systables import (  # noqa: F401
+    SYSTEM_TABLES,
+    SystemSnapshot,
+    SystemTableSource,
+    build_query_record,
+    is_system_table,
+    record_query,
+)

@@ -283,6 +283,13 @@ class JobProfileCollector:
             slot = self._jobs.get(job_id)
             return slot["path"] if slot else None
 
+    def task_payloads(self, job_id: str) -> List[dict]:
+        """The job's collected per-task profile payloads (shared list
+        snapshot; callers must not mutate the payload dicts)."""
+        with self._lock:
+            slot = self._jobs.get(job_id)
+            return list(slot["tasks"]) if slot else []
+
     def build(self, job_id: str,
               wall_seconds: Optional[float] = None,
               sched_records: Optional[List[dict]] = None) -> Optional[dict]:
@@ -386,11 +393,14 @@ def dump_ring_artifact(label: str, t0: float, wall: float,
 
 
 @contextmanager
-def watch_slow_query(label_fn: Callable[[], str]):
+def watch_slow_query(label_fn: Callable[[], str],
+                     artifact_out: Optional[list] = None):
     """Wrap a standalone collect: when ``BALLISTA_SLOW_QUERY_SECS`` is
     set and the wrapped block takes at least that long, dump a
     retroactive artifact from the flight recorder. Costs nothing when
-    the threshold is unset; never raises into the query."""
+    the threshold is unset; never raises into the query.
+    ``artifact_out`` (a list) receives the written artifact path so the
+    caller can link it from the query-history record."""
     from .health import slow_query_secs
 
     thr = slow_query_secs()
@@ -416,6 +426,8 @@ def watch_slow_query(label_fn: Callable[[], str]):
                 path = dump_ring_artifact(label, t0, wall,
                                           phases0=phases0,
                                           compile0=compile0)
+                if path and artifact_out is not None:
+                    artifact_out.append(path)
                 if path:
                     log.warning(
                         "slow query (%.3fs >= %.3fs): retroactive "

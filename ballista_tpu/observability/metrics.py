@@ -280,9 +280,15 @@ def resolve_plan_pending(plan) -> None:
 def reset_plan_metrics(plan) -> None:
     """Zero every operator's MetricsSet. EXPLAIN ANALYZE re-runs a
     possibly cached plan and must report THIS run, not the lifetime
-    accumulation."""
+    accumulation. The root's metrics EPOCH is bumped so deferred
+    harvesters (system.operators' lazy snapshot of a past query) can
+    tell that their values were clobbered by a newer run."""
     for n in _plan_nodes(plan):
         n.metrics().reset()
+    try:
+        plan._metrics_epoch = getattr(plan, "_metrics_epoch", 0) + 1
+    except AttributeError:
+        pass  # slotted plan node: epoch tracking degrades gracefully
 
 
 def _fused_members(node) -> list:

@@ -235,6 +235,9 @@ def source_to_proto(src: lp.TableSource, primary_key: Optional[str] = None
         schema=schema_to_proto(src.table_schema()),
         primary_key=primary_key or "",
         num_partitions=d.get("num_partitions", 0),
+        # system sources: the snapshot rows, materialized at
+        # serialization time (observability/systables.py)
+        payload=d.get("rows_json", "").encode(),
     )
 
 
@@ -249,6 +252,13 @@ def source_from_proto(p: pb.TableSourceDesc) -> lp.TableSource:
                          delimiter=p.delimiter or ",")
     if p.kind == "parquet":
         return ParquetSource(p.path, schema)
+    if p.kind == "system":
+        import json
+
+        from .observability.systables import SystemTableSource
+
+        return SystemTableSource(p.path,
+                                 rows=json.loads(p.payload.decode()))
     raise SerdeError(f"source kind {p.kind!r} is not remotable")
 
 

@@ -305,6 +305,12 @@ class Parser:
                 raise SqlError("derived table requires an alias")
             return TableRef(f"__subquery_{alias}", alias, subquery=sub)
         name = self.expect_ident()
+        # dotted table names (one schema level, e.g. ``system.queries``):
+        # consumed here so the catalog can key on the qualified name
+        if self.peek().kind == "op" and self.peek().value == "." and \
+                self.peek(1).kind in ("ident", "kw"):
+            self.next()
+            name = f"{name}.{self.next().value}"
         alias = None
         if self.accept_kw("as"):
             alias = self.expect_ident()

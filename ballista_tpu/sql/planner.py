@@ -70,8 +70,37 @@ class Relation:
 
 
 class SqlPlanner:
-    def __init__(self, catalog: Dict[str, CatalogTable]):
+    def __init__(self, catalog: Dict[str, CatalogTable],
+                 system_provider=None):
         self.catalog = catalog
+        # system_provider(name) -> TableSource for a ``system.*`` table
+        # (observability/systables.py). None falls back to the current
+        # process's snapshot, so SQL over system tables works anywhere a
+        # planner does; contexts pass a provider that routes remote
+        # scans to the scheduler.
+        self._system_provider = system_provider
+        # resolved system CatalogTables, cached per planner so one
+        # query's several references share a source instance
+        self._system_tables: Dict[str, CatalogTable] = {}
+
+    def _table(self, name: str) -> Optional[CatalogTable]:
+        """Catalog lookup with ``system.*`` fallthrough: registered
+        tables always win (a user may shadow a system name)."""
+        t = self.catalog.get(name)
+        if t is not None:
+            return t
+        from ..observability.systables import (SystemTableSource,
+                                               is_system_table)
+
+        if not is_system_table(name):
+            return None
+        t = self._system_tables.get(name)
+        if t is None:
+            src = (self._system_provider(name)
+                   if self._system_provider is not None
+                   else SystemTableSource(name))
+            t = self._system_tables[name] = CatalogTable(name, src)
+        return t
 
     # ------------------------------------------------------------------ API
 
@@ -124,9 +153,9 @@ class SqlPlanner:
                 sub_plan = self.plan(r.subquery)
                 raw.append((alias, r, sub_plan.schema(), None, sub_plan))
             else:
-                if r.name not in self.catalog:
+                t = self._table(r.name)
+                if t is None:
                     raise SqlError(f"unknown table {r.name!r}")
-                t = self.catalog[r.name]
                 if t.plan is not None:  # registered DataFrame: a view
                     # inline a COPY: execution mutates plans in place
                     # (resolve_scalar_subqueries bakes literals into expr
@@ -155,7 +184,7 @@ class SqlPlanner:
             if sub_plan is not None:
                 base: LogicalPlan = sub_plan
             else:
-                t = self.catalog[r.name]
+                t = self._table(r.name)
                 base = TableScan(t.name, t.source)
             if needs_rename:
                 rename = {

@@ -73,6 +73,57 @@ def cold_phase_split(run_fn):
     }
 
 
+def profiled_query(ctx, name: str, sql: str, runs: int, result: dict,
+                   timed, lane_prefix: str) -> None:
+    """Shared TPC-H query measurement: the FIRST run executes under a
+    profiler window so the named wall-time lanes land in the JSON line
+    (`{lane_prefix}device_blocked_seconds` etc. — q5 keeps the
+    unprefixed legacy names, q3/q18 prefix theirs), then a warm
+    minimum. Lanes land only for a SUCCESSFUL first run: a query that
+    died mid-run must not gate truncated (artificially good) lane
+    values against a baseline in dev/check_bench_regress.py."""
+    prof = None
+    try:
+        from ballista_tpu.observability.profiler import Profiler
+
+        prof = Profiler(label=f"{name}-first")
+        prof.start()
+    except Exception as e:  # noqa: BLE001 - lanes are best-effort
+        print(f"# {name} lane profiler unavailable: {e}", file=sys.stderr)
+        prof = None
+    try:
+        df = ctx.sql(sql)
+        first = timed(df)  # load + compile
+        if prof is not None:
+            try:
+                from ballista_tpu.observability.export import compute_lanes
+
+                session, prof = prof.stop(), None
+                lane_info = compute_lanes(session)
+                lanes = lane_info["lanes"]
+                result[f"{lane_prefix}device_blocked_seconds"] = \
+                    lanes["device_blocked"]
+                result[f"{lane_prefix}host_dictionary_seconds"] = \
+                    lanes["host_dictionary"]
+                result[f"{lane_prefix}compile_trace_lower_seconds"] = \
+                    lanes["compile_trace_lower"]
+                result[f"{lane_prefix}attributed_fraction"] = \
+                    lane_info["attributed_fraction"]
+            except Exception as e:  # noqa: BLE001
+                print(f"# {name} lane extraction failed: {e}",
+                      file=sys.stderr)
+        warm = min(timed(df) for _ in range(max(runs - 1, 1)))
+        result[f"{name}_first_seconds"] = round(first, 4)
+        result[f"{name}_warm_seconds"] = round(warm, 4)
+    except Exception as e:  # noqa: BLE001 - q1 metric still reports
+        print(f"# {name} failed: {e}", file=sys.stderr)
+        if prof is not None:
+            try:
+                prof.stop()
+            except Exception:  # noqa: BLE001 - already stopped
+                pass
+
+
 def instrument_q1(data_dir: str, runs: int):
     """Per-stage decomposition of q1 + an AOT-compiled kernel measurement.
 
@@ -514,55 +565,27 @@ def _run_bench(args) -> None:
     # The first q5 run executes under a profiler window so the named
     # wall-time lanes land in the JSON line: ROADMAP targets cite them
     # (item 2 wants host_dictionary < 0.5s) and
-    # dev/check_bench_regress.py gates them between rounds.
-    q5_sql = open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "benchmarks", "tpch", "queries", "q5.sql")).read()
-    q5_warm = None
-    try:
-        from ballista_tpu.observability.export import compute_lanes
-        from ballista_tpu.observability.profiler import Profiler
-
-        prof = Profiler(label="q5-first")
-        prof.start()
-    except Exception as e:  # noqa: BLE001 - lanes are best-effort
-        print(f"# q5 lane profiler unavailable: {e}", file=sys.stderr)
-        prof = None
-    try:
-        df5 = ctx.sql(q5_sql)
-        q5_first = timed(df5)  # load + compile
-        # lanes land only for a SUCCESSFUL run: a q5 that died mid-query
-        # must not gate truncated (artificially good) lane values
-        # against a baseline in dev/check_bench_regress.py
-        if prof is not None:
-            try:
-                session, prof = prof.stop(), None
-                lane_info = compute_lanes(session)
-                lanes = lane_info["lanes"]
-                result["device_blocked_seconds"] = \
-                    lanes["device_blocked"]
-                result["host_dictionary_seconds"] = \
-                    lanes["host_dictionary"]
-                result["compile_trace_lower_seconds"] = \
-                    lanes["compile_trace_lower"]
-                result["attributed_fraction"] = \
-                    lane_info["attributed_fraction"]
-            except Exception as e:  # noqa: BLE001
-                print(f"# q5 lane extraction failed: {e}",
-                      file=sys.stderr)
-        q5_warm = min(timed(df5) for _ in range(max(args.runs - 1, 1)))
-        result["q5_first_seconds"] = round(q5_first, 4)
-    except Exception as e:  # noqa: BLE001 - q1 metric still reports
-        print(f"# q5 failed: {e}", file=sys.stderr)
-        if prof is not None:
-            try:
-                prof.stop()
-            except Exception:  # noqa: BLE001 - already stopped
-                pass
-
-    if q5_warm is not None:
-        result["q5_warm_seconds"] = round(q5_warm, 4)
-        result["q5_rows_per_sec"] = round(total_rows / q5_warm, 1)
+    # dev/check_bench_regress.py gates them between rounds. q5 keeps
+    # the unprefixed legacy lane field names.
+    qdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "tpch", "queries")
+    profiled_query(ctx, "q5", open(os.path.join(qdir, "q5.sql")).read(),
+                   args.runs, result, timed, lane_prefix="")
+    if "q5_warm_seconds" in result:
+        result["q5_rows_per_sec"] = round(
+            total_rows / result["q5_warm_seconds"], 1)
     snapshot("q5_done")
+
+    # -- q3 / q18 (ROADMAP item 5: grow bench coverage beyond
+    # q1/q5/q12/q16 so the caches and AQE rules see diverse plan shapes
+    # — q3 is join-heavy with a top-k sort, q18 a high-cardinality
+    # aggregation feeding a join). Same lane/phase fields as q5,
+    # prefixed per query; dev/check_bench_regress.py gates them.
+    for qname in ("q3", "q18"):
+        profiled_query(ctx, qname,
+                       open(os.path.join(qdir, f"{qname}.sql")).read(),
+                       args.runs, result, timed, lane_prefix=f"{qname}_")
+    snapshot("q3_q18_done")
 
     # -- q16 (COUNT(DISTINCT) query; the fused distinct-count kernel's
     # pinned workload — ISSUE 6 targets >=2x its r05 warm time) --------------

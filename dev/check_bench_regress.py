@@ -44,12 +44,23 @@ METRICS: Dict[str, Tuple[str, float]] = {
     "first_run_seconds": ("lower", 0.35),
     "q5_first_seconds": ("lower", 0.35),
     "q5_warm_seconds": ("lower", 0.30),
+    "q3_first_seconds": ("lower", 0.35),
+    "q3_warm_seconds": ("lower", 0.30),
+    "q18_first_seconds": ("lower", 0.35),
+    "q18_warm_seconds": ("lower", 0.30),
     "q16_first_seconds": ("lower", 0.35),
     "q16_warm_seconds": ("lower", 0.30),
-    # profiler lanes (PR 7): the ROADMAP's lane-cited targets
+    # profiler lanes (PR 7; unprefixed = q5, PR 8 added q3/q18): the
+    # ROADMAP's lane-cited targets
     "device_blocked_seconds": ("lower", 0.45),
     "host_dictionary_seconds": ("lower", 0.45),
     "compile_trace_lower_seconds": ("lower", 0.45),
+    "q3_device_blocked_seconds": ("lower", 0.45),
+    "q3_host_dictionary_seconds": ("lower", 0.45),
+    "q3_compile_trace_lower_seconds": ("lower", 0.45),
+    "q18_device_blocked_seconds": ("lower", 0.45),
+    "q18_host_dictionary_seconds": ("lower", 0.45),
+    "q18_compile_trace_lower_seconds": ("lower", 0.45),
     # resource envelope
     "peak_rss_mb": ("lower", 0.30),
 }

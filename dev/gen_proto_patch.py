@@ -202,6 +202,23 @@ def apply_profiler(fdp: dp.FileDescriptorProto) -> None:
         add_field(m, "error", 2, F.TYPE_STRING)
 
 
+def apply_systables(fdp: dp.FileDescriptorProto) -> None:
+    """PR 8: SQL-queryable system.* tables (mirrored by hand in
+    ballista.proto; dev/check_proto_sync.py guards the drift) — the
+    serialized-snapshot payload on TableSourceDesc and the
+    GetSystemTable RPC serving scheduler snapshots to remote scans."""
+    add_field(get_message(fdp, "TableSourceDesc"), "payload", 8,
+              F.TYPE_BYTES)
+
+    if not has_message(fdp, "GetSystemTableParams"):
+        m = fdp.message_type.add(name="GetSystemTableParams")
+        add_field(m, "table", 1, F.TYPE_STRING)
+    if not has_message(fdp, "GetSystemTableResult"):
+        m = fdp.message_type.add(name="GetSystemTableResult")
+        add_field(m, "rows_json", 1, F.TYPE_BYTES)
+        add_field(m, "error", 2, F.TYPE_STRING)
+
+
 TEMPLATE = '''# -*- coding: utf-8 -*-
 # Generated by dev/gen_proto_patch.py (no protoc in this image). DO NOT EDIT!
 # source: ballista.proto
@@ -232,6 +249,7 @@ def main() -> None:
     apply_adaptive(fdp)
     apply_health(fdp)
     apply_profiler(fdp)
+    apply_systables(fdp)
     out = TEMPLATE.format(blob=fdp.SerializeToString())
     with open(PB2, "w") as f:
         f.write(out)
