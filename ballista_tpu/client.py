@@ -14,6 +14,8 @@ Two modes:
 
 from __future__ import annotations
 
+import threading
+from contextlib import contextmanager
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from .datatypes import Schema, dtype_from_name, schema as make_schema
@@ -70,6 +72,13 @@ class BallistaContext:
         # job id of the last remote query: the handle df.profile() and
         # /debug/profile/<job_id> take on the cluster path
         self._last_job_id = None
+        # query lifecycle (lifecycle.py / docs/robustness.md): cancel
+        # tokens of in-flight standalone collects and the live job-id
+        # sinks of in-flight remote collects — what ctx.cancel() fires
+        # from another thread
+        self._lifecycle_lock = threading.Lock()
+        self._active_tokens: List = []
+        self._active_job_sinks: List[list] = []
 
     # -- constructors -------------------------------------------------------
 
@@ -241,6 +250,51 @@ class BallistaContext:
 
     # -- execution ----------------------------------------------------------
 
+    @contextmanager
+    def _track_lifecycle(self, obj, registry: list):
+        """Register an in-flight query's cancel handle (a CancelToken
+        or a live remote job-id sink) for the duration of the collect,
+        so a concurrent ``ctx.cancel()`` can reach it."""
+        with self._lifecycle_lock:
+            registry.append(obj)
+        try:
+            yield obj
+        finally:
+            with self._lifecycle_lock:
+                try:
+                    registry.remove(obj)
+                except ValueError:
+                    pass
+
+    def cancel(self, reason: str = "client") -> int:
+        """Cooperatively cancel this context's in-flight queries (call
+        from another thread). Standalone collects stop at their next
+        batch boundary and raise :class:`errors.QueryCancelled`; remote
+        collects get a best-effort ``CancelJob`` for every job this
+        context currently has in flight. Returns how many queries/jobs
+        this call cancelled. Queries land as terminal ``cancelled`` in
+        ``system.queries`` with the given reason."""
+        with self._lifecycle_lock:
+            tokens = list(self._active_tokens)
+            job_ids = [jid for sink in self._active_job_sinks
+                       for jid in list(sink)]
+        n = 0
+        for t in tokens:
+            n += bool(t.cancel(reason))
+        if self.mode == "remote" and job_ids:
+            import logging
+
+            from .distributed.client import cancel_job
+
+            for jid in job_ids:
+                try:
+                    n += bool(cancel_job(self.host, self.port, jid,
+                                         reason))
+                except Exception:  # noqa: BLE001 - best-effort
+                    logging.getLogger("ballista.lifecycle").warning(
+                        "CancelJob(%s) failed", jid, exc_info=True)
+        return n
+
     def _collect(self, plan: LogicalPlan):
         if self.mode == "standalone":
             out, _ = self._standalone_collect(plan)
@@ -249,8 +303,11 @@ class BallistaContext:
 
         sink: list = []
         jsink: list = []
-        out = remote_collect(self.host, self.port, plan, self.settings,
-                             metrics_out=sink, job_id_out=jsink)
+        # jsink receives the job id at SUBMIT time, so a concurrent
+        # ctx.cancel() can CancelJob the job while this thread waits
+        with self._track_lifecycle(jsink, self._active_job_sinks):
+            out = remote_collect(self.host, self.port, plan, self.settings,
+                                 metrics_out=sink, job_id_out=jsink)
         self._last_query_metrics = sink[0] if sink else None
         self._last_query_phys = None
         self._last_job_id = jsink[0] if jsink else None
@@ -334,6 +391,17 @@ class BallistaContext:
                 rec.artifact_path = slow_sink[0]
 
     def _standalone_collect_inner(self, plan: LogicalPlan, phys=None):
+        from .lifecycle import CancelToken, bind_token, slow_query_killer
+
+        # one cancel token per collect: ctx.cancel() fires it from
+        # another thread, the slow-query killer fires it on timeout,
+        # and every batch boundary under the bind checks it
+        token = CancelToken()
+        with self._track_lifecycle(token, self._active_tokens), \
+                bind_token(token), slow_query_killer(token):
+            return self._standalone_collect_governed(plan, phys)
+
+    def _standalone_collect_governed(self, plan: LogicalPlan, phys=None):
         import pandas as pd
 
         from .execution import collect_physical, plan_logical
@@ -567,11 +635,13 @@ class DataFrame:
 
             sink: list = []
             jsink: list = []
-            out = remote_sql_collect(
-                self.ctx.host, self.ctx.port, self._raw_sql,
-                self.ctx._catalog, self.ctx.settings, metrics_out=sink,
-                job_id_out=jsink,
-            )
+            with self.ctx._track_lifecycle(jsink,
+                                           self.ctx._active_job_sinks):
+                out = remote_sql_collect(
+                    self.ctx.host, self.ctx.port, self._raw_sql,
+                    self.ctx._catalog, self.ctx.settings, metrics_out=sink,
+                    job_id_out=jsink,
+                )
             self.ctx._last_query_metrics = sink[0] if sink else None
             self.ctx._last_query_phys = None
             self.ctx._last_job_id = jsink[0] if jsink else None
@@ -584,6 +654,11 @@ class DataFrame:
 
     def to_pandas(self):
         return self.collect()
+
+    def cancel(self, reason: str = "client") -> int:
+        """Cancel the context's in-flight queries (this frame's collect
+        included) — see :meth:`BallistaContext.cancel`."""
+        return self.ctx.cancel(reason)
 
     def profile(self, path: Optional[str] = None,
                 label: Optional[str] = None) -> str:

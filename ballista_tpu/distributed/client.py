@@ -12,13 +12,27 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ..errors import ClusterError, PlanError
+from ..errors import ClusterError, PlanError, QueryCancelled
 from ..proto import ballista_pb2 as pb
 from .. import serde
 from .dataplane import fetch_partition_bytes
 from .scheduler import SchedulerClient
 
 POLL_SECS = 0.1  # reference: 100ms, context.rs:183-201
+
+
+def _deadline_secs(settings: Optional[Dict[str, str]]) -> float:
+    """``job.deadline`` setting: server-side deadline in seconds (0 =
+    none). Unlike ``job.timeout`` — which only bounds how long THIS
+    client waits — the deadline rides ExecuteQueryParams and the
+    scheduler's reap pass cancels the job once it expires, even when
+    the submitting client is long gone."""
+    raw = (settings or {}).get("job.deadline", 0)
+    try:
+        return max(float(raw), 0.0)
+    except ValueError:
+        raise ClusterError(f"invalid job.deadline setting: {raw!r} "
+                           "(expected seconds as a number)") from None
 
 
 def submit_plan(host: str, port: int, logical_plan,
@@ -29,9 +43,35 @@ def submit_plan(host: str, port: int, logical_plan,
         params.logical_plan.CopyFrom(serde.plan_to_proto(logical_plan))
         for k, v in (settings or {}).items():
             params.settings[k] = v
+        params.deadline_secs = _deadline_secs(settings)
         return client.ExecuteQuery(params).job_id
     finally:
         client.close()
+
+
+def cancel_job(host: str, port: int, job_id: str,
+               reason: str = "client") -> bool:
+    """Cooperatively cancel a running job (CancelJob RPC). Returns True
+    when this call moved the job to its terminal Cancelled state (False:
+    unknown job or already terminal). Queued tasks are dropped at the
+    scheduler; running tasks abort at their next batch boundary once
+    their executor's poll carries the id."""
+    client = SchedulerClient(host, port)
+    try:
+        res = client.CancelJob(
+            pb.CancelJobParams(job_id=job_id, reason=reason))
+        return res.cancelled
+    finally:
+        client.close()
+
+
+def _cancel_on_timeout_enabled() -> bool:
+    """``BALLISTA_CANCEL_ON_TIMEOUT`` (default on): a client-side job
+    timeout issues a best-effort CancelJob before raising, so an
+    abandoned client doesn't leak a running job. ``0``/``off`` restores
+    the old abandon-the-job behavior."""
+    return os.environ.get("BALLISTA_CANCEL_ON_TIMEOUT", "on").lower() \
+        not in ("0", "off", "false", "no")
 
 
 def _sql_references_table(sql: str, name: str) -> bool:
@@ -92,6 +132,7 @@ def submit_sql(host: str, port: int, sql: str, catalog,
             entry.source.CopyFrom(
                 serde.source_to_proto(ct.source, ct.primary_key)
             )
+        params.deadline_secs = _deadline_secs(settings)
         return client.ExecuteQuery(params).job_id
     finally:
         client.close()
@@ -109,10 +150,34 @@ def wait_for_job(host: str, port: int, job_id: str,
                 return result
             if which == "failed":
                 raise ClusterError(
-                    f"job {job_id} failed: {result.status.failed.error}"
+                    f"job {job_id} failed: {result.status.failed.error}",
+                    job_id=job_id,
+                )
+            if which == "cancelled":
+                # terminal Cancelled (client CancelJob, server deadline,
+                # slow-query kill, drain): distinct from failure so
+                # callers can tell "stopped on purpose" from "broke"
+                raise QueryCancelled(
+                    result.status.cancelled.reason or "unknown",
+                    job_id=job_id,
                 )
             if time.time() > deadline:
-                raise ClusterError(f"job {job_id} timed out")
+                if _cancel_on_timeout_enabled():
+                    # best-effort: an abandoned client must not leak a
+                    # running job burning executor slots; the job id on
+                    # the error lets the caller inspect system.queries
+                    try:
+                        client.CancelJob(pb.CancelJobParams(
+                            job_id=job_id, reason="timeout"))
+                    except Exception:  # noqa: BLE001 - best-effort
+                        pass
+                raise ClusterError(
+                    f"job {job_id} timed out after {timeout:.1f}s "
+                    "(best-effort CancelJob issued; see system.queries)"
+                    if _cancel_on_timeout_enabled() else
+                    f"job {job_id} timed out after {timeout:.1f}s",
+                    job_id=job_id,
+                )
             time.sleep(POLL_SECS)
     finally:
         client.close()

@@ -105,7 +105,17 @@ def fetch_partition_bytes(host: str, port: int, job_id: str, stage_id: int,
 
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
+        from ..testing.faults import fault_point
+
         try:
+            # "drop" = close without a response (the peer sees a dead
+            # connection, exactly like a mid-transfer crash); "fail"
+            # raises and is reported as an error response below. Only
+            # the Python server has this point — the native C++ daemon
+            # is out of fault-injection reach (tests arm it with
+            # BALLISTA_NATIVE_DATAPLANE=off).
+            if fault_point("dataplane.serve") == "drop":
+                return
             (length,) = struct.unpack(">I", _recv_exact(self.request, 4))
             action = pb.Action()
             action.ParseFromString(_recv_exact(self.request, length))

@@ -15,17 +15,23 @@ from __future__ import annotations
 
 import logging
 import os
+import random
+import shutil
 import tempfile
 import threading
 import time
 import uuid
+from collections import deque
 from concurrent import futures
-from typing import Optional
+from typing import Dict, Optional
 
+from ..errors import QueryCancelled
+from ..lifecycle import CancelToken, bind_token, check_cancel
 from ..observability import trace_span
 from ..observability.metrics import collect_plan_metrics, metrics_enabled
 from ..proto import ballista_pb2 as pb
 from .. import serde
+from ..testing.faults import fault_point
 from .dataplane import partition_path, start_data_plane
 from .scheduler import SchedulerClient
 from .types import PartitionId
@@ -36,6 +42,25 @@ POLL_INTERVAL_SECS = 0.25  # reference: 250ms, execution_loop.rs:41
 # total task-profile bytes one PollWork may carry (well under the
 # transport's raised 64 MB cap; see scheduler._GRPC_MSG_OPTS)
 _POLL_PROFILE_BUDGET_BYTES = 8 << 20
+
+
+def _poll_backoff_max_secs() -> float:
+    """Poll-loop backoff ceiling while the scheduler is unreachable."""
+    try:
+        return max(float(os.environ.get(
+            "BALLISTA_POLL_BACKOFF_MAX_SECS", "8") or 8), POLL_INTERVAL_SECS)
+    except ValueError:
+        return 8.0
+
+
+def drain_timeout_secs() -> float:
+    """``BALLISTA_DRAIN_TIMEOUT_SECS``: how long a graceful drain lets
+    in-flight tasks finish before cancelling them."""
+    try:
+        return max(float(os.environ.get(
+            "BALLISTA_DRAIN_TIMEOUT_SECS", "20") or 20), 0.0)
+    except ValueError:
+        return 20.0
 
 
 def _needs_mesh(plan) -> bool:
@@ -112,6 +137,15 @@ class Executor:
         self._pending_status = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # lifecycle control plane: one cancel token per active task
+        # (registered BEFORE the pool accepts the work so drain sees
+        # queued-but-unstarted tasks too), the draining flag PollWork
+        # advertises as can_accept_task=False, and a bounded memory of
+        # job ids whose partial outputs were already cleaned
+        self._token_lock = threading.Lock()
+        self._task_tokens: Dict[str, CancelToken] = {}  # task key -> token
+        self._draining = False
+        self._cleaned_jobs: deque = deque(maxlen=256)
         # health plane: task counters (benign-race ints under the GIL,
         # same policy as observability.metrics), a ring of recent task
         # summaries, and — when enabled — /healthz + /metrics +
@@ -119,6 +153,7 @@ class Executor:
         self._inflight = 0
         self.tasks_completed = 0
         self.tasks_failed = 0
+        self.tasks_cancelled = 0
         from ..observability.health import (QueryLog,
                                             maybe_start_health_server,
                                             metrics_port_from_env)
@@ -166,6 +201,7 @@ class Executor:
             ("ballista_ingest_pool_depth", {}, pool_queue_depth()),
             ("ballista_tasks_completed_total", {}, self.tasks_completed),
             ("ballista_tasks_failed_total", {}, self.tasks_failed),
+            ("ballista_tasks_cancelled_total", {}, self.tasks_cancelled),
         ]
 
     # -- lifecycle ----------------------------------------------------------
@@ -176,29 +212,129 @@ class Executor:
         )
         self._thread.start()
 
-    def stop(self):
+    def stop(self, drain: bool = False,
+             drain_timeout: Optional[float] = None):
+        """Stop the executor. ``drain=False`` (default) keeps the old
+        immediate-shutdown behavior: running tasks are abandoned
+        mid-flight. ``drain=True`` is the graceful path: stop accepting
+        (PollWork advertises ``can_accept_task=False``), give in-flight
+        tasks up to the drain bound to finish, cancel whatever is still
+        running (their failure reports are transient-shaped, so the
+        scheduler re-queues them elsewhere), and flush
+        ``_pending_status`` in one final poll so completion reports are
+        never lost."""
+        if drain:
+            self._drain(drain_timeout)
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+        if drain:
+            # final flush AFTER the poll thread stopped: whatever
+            # reports the last in-flight tasks appended still reach the
+            # scheduler even though no more polls will run
+            try:
+                self._flush_status()
+            except Exception:  # noqa: BLE001 - best-effort on shutdown
+                log.warning("final status flush failed", exc_info=True)
         self._data_plane.close()
         self._pool.shutdown(wait=False)
         if self._health is not None:
             self._health.close()
 
+    def _drain(self, drain_timeout: Optional[float]):
+        bound = (drain_timeout if drain_timeout is not None
+                 else drain_timeout_secs())
+        self._draining = True
+        deadline = time.time() + bound
+        log.info("draining executor %s: %d active task(s), bound %.1fs",
+                 self.id[:8], len(self._task_tokens), bound)
+        while time.time() < deadline and self._task_tokens:
+            time.sleep(0.05)
+        leftover = self._fire_tokens(reason="drain")
+        if leftover:
+            log.warning("drain bound hit; cancelled %d in-flight task(s)",
+                        leftover)
+            # cooperative aborts land at the next batch boundary; give
+            # them a short grace so their failure reports make the
+            # final flush
+            grace = time.time() + 5.0
+            while time.time() < grace and self._task_tokens:
+                time.sleep(0.05)
+
+    def _fire_tokens(self, reason: str,
+                     job_id: Optional[str] = None) -> int:
+        """Fire the cancel tokens of active tasks (all, or one job's);
+        returns how many were fired."""
+        with self._token_lock:
+            tokens = [t for t in self._task_tokens.values()
+                      if job_id is None or t.job_id == job_id]
+        n = 0
+        for t in tokens:
+            if t.cancel(reason):
+                n += 1
+        return n
+
+    def _flush_status(self):
+        """One synchronous PollWork carrying only pending reports (no
+        task request): the drain path's last word to the scheduler."""
+        with self._status_lock:
+            pending = list(self._pending_status)
+            self._pending_status.clear()
+        if not pending:
+            return
+        params = pb.PollWorkParams(can_accept_task=False)
+        params.metadata.id = self.id
+        params.metadata.host = self.config.host
+        params.metadata.port = self.port
+        params.metadata.num_devices = self.config.num_devices
+        for st in pending:
+            # profiles are advisory payload; the final flush is about
+            # never losing the REPORTS
+            if st.HasField("completed") and st.completed.HasField("profile"):
+                st.completed.ClearField("profile")
+            params.task_status.append(st)
+        self._client.PollWork(params)
+
     # -- poll loop (reference: execution_loop.rs:31-76) ----------------------
 
     def _poll_loop(self):
+        failures = 0
+        backoff = 0.0
         while not self._stop.is_set():
             try:
                 self._poll_once()
-            except Exception:  # noqa: BLE001 - warn and retry like reference
-                log.exception("poll failed; retrying")
+            except Exception as e:  # noqa: BLE001 - retry like reference
+                # jittered exponential backoff (reset on success): a
+                # scheduler restart must not face a thundering herd of
+                # fixed-interval retries, and a down scheduler must not
+                # fill the log with one traceback per 250ms
+                failures += 1
+                backoff = min(max(backoff * 2, POLL_INTERVAL_SECS),
+                              _poll_backoff_max_secs())
+                wait = backoff * (1.0 + 0.25 * random.random())
+                if failures == 1:
+                    log.exception("poll failed; backing off")
+                else:
+                    log.warning(
+                        "poll still failing (%d consecutive; %s: %s); "
+                        "next retry in %.2fs", failures,
+                        type(e).__name__, e, wait)
+                self._stop.wait(wait)
+                continue
+            if failures:
+                log.info("scheduler reachable again after %d failed "
+                         "poll(s)", failures)
+            failures = 0
+            backoff = 0.0
             self._stop.wait(POLL_INTERVAL_SECS)
 
     def _poll_once(self):
         can_accept = self._slots.acquire(blocking=False)
         if can_accept:
             self._slots.release()
+        if self._draining:
+            # graceful drain: finish what's in flight, accept nothing new
+            can_accept = False
         params = pb.PollWorkParams(can_accept_task=can_accept)
         params.metadata.id = self.id
         params.metadata.host = self.config.host
@@ -232,9 +368,42 @@ class Executor:
                 else:
                     budget -= sz
             params.task_status.append(st)
-        result = self._client.PollWork(params)
+        try:
+            result = self._client.PollWork(params)
+        except Exception:
+            # report re-delivery: a failed poll (scheduler down, RPC
+            # fault) must not LOSE the completion/failure reports it
+            # carried — without them the scheduler only recovers the
+            # tasks via lease reaping or speculation, minutes later.
+            # Re-front them so the next successful poll delivers
+            # (profiles already stripped above stay stripped: advisory)
+            with self._status_lock:
+                self._pending_status[:0] = pending
+            raise
+        for job_id in result.cancelled_jobs:
+            self._handle_job_cancelled(job_id)
         if result.HasField("task"):
             self._run_task(result.task)
+
+    def _handle_job_cancelled(self, job_id: str):
+        """A PollWorkResult carried this job id as cancelled: abort its
+        running tasks at their next batch boundary and clean up partial
+        stage outputs (completed shuffle files included — nothing will
+        ever read them). Idempotent across polls: the id rides every
+        poll for a broadcast window."""
+        fired = self._fire_tokens(reason="cancelled", job_id=job_id)
+        if fired:
+            log.info("job %s cancelled; aborting %d running task(s)",
+                     job_id, fired)
+        if job_id not in self._cleaned_jobs:
+            self._cleaned_jobs.append(job_id)
+            self._cleanup_job_outputs(job_id)
+
+    def _cleanup_job_outputs(self, job_id: str):
+        path = os.path.join(self.config.work_dir, job_id)
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+            log.info("removed cancelled job outputs: %s", path)
 
     # -- task execution (in-process; reference: run_received_tasks) ----------
 
@@ -242,20 +411,40 @@ class Executor:
         self._slots.acquire()
         pid = PartitionId(td.task_id.job_id, td.task_id.stage_id,
                           td.task_id.partition_id)
-        plan = serde.physical_from_proto(td.plan)
-        # whole-stage fusion happens AFTER deserialization, executor-
-        # side: the wire format never carries fused operators, and a
-        # re-planned stage's fresh task re-fuses to the same value-keyed
-        # signatures (zero new compiles)
-        from ..physical.fusion import maybe_fuse
+        # per-task cancel token: registered BEFORE the pool accepts the
+        # work so a cancel/drain arriving while the task is still queued
+        # aborts it at entry, not after a full execution
+        token = CancelToken(job_id=pid.job_id)
+        with self._token_lock:
+            self._task_tokens[pid.key()] = token
+        try:
+            plan = serde.physical_from_proto(td.plan)
+            # whole-stage fusion happens AFTER deserialization, executor-
+            # side: the wire format never carries fused operators, and a
+            # re-planned stage's fresh task re-fuses to the same value-
+            # keyed signatures (zero new compiles)
+            from ..physical.fusion import maybe_fuse
 
-        plan = maybe_fuse(plan)
-        shuffle = None
-        if td.shuffle_output_partitions:
-            hash_exprs = [
-                serde.expr_from_proto(e) for e in td.shuffle_hash_exprs
-            ]
-            shuffle = (hash_exprs or None, td.shuffle_output_partitions)
+            plan = maybe_fuse(plan)
+            shuffle = None
+            if td.shuffle_output_partitions:
+                hash_exprs = [
+                    serde.expr_from_proto(e) for e in td.shuffle_hash_exprs
+                ]
+                shuffle = (hash_exprs or None, td.shuffle_output_partitions)
+        except Exception as e:  # noqa: BLE001 - bad plan/wire payload
+            # deserialize/fuse failed BEFORE the pool accepted the work:
+            # release the slot and the registered token (a leaked token
+            # would make every future drain wait its full bound) and
+            # report the failure instead of wedging the task forever
+            with self._token_lock:
+                self._task_tokens.pop(pid.key(), None)
+            self._slots.release()
+            log.exception("task %s rejected before execution", pid)
+            self.tasks_failed += 1
+            self._report_failed(pid, f"{type(e).__name__}: {e}",
+                                td.stage_version)
+            return
 
         def work():
             from ..observability import distributed as obs_dist
@@ -274,12 +463,21 @@ class Executor:
 
                 phases0, compile0 = phase_totals(), compile_stats()
             try:
+                # fault point (chaos sweep): an injected failure here is
+                # a transient task failure — the scheduler re-queues it
+                # within the retry budget
+                fault_point("executor.task.start", task=pid.key())
+                # token checked at entry (a queued task of an already-
+                # cancelled job must not run at all), then bound to the
+                # thread so every batch boundary under execute sees it
+                token.check()
                 # flow(): every span/event emitted while this task runs
                 # (ingest producers included — PrefetchHandle re-binds
                 # the captured flow on its pool worker) carries the
                 # job/stage/task triple for cross-process correlation
-                with flow(job=pid.job_id, stage=pid.stage_id,
-                          task=pid.key()), \
+                with bind_token(token), \
+                        flow(job=pid.job_id, stage=pid.stage_id,
+                             task=pid.key()), \
                         trace_span("executor.task", task=pid.key(),
                                    executor=self.id[:8]):
                     if self.mesh_group is not None and _needs_mesh(plan):
@@ -314,6 +512,29 @@ class Executor:
                     "rows": int(stats.get("num_rows", 0)),
                     "output_rows": int(stats.get("num_rows", 0)),
                 })
+            except QueryCancelled as e:
+                # cooperative abort at a batch boundary: terminal for
+                # this attempt but NOT a failure. The report is still
+                # filed ("QueryCancelled:" is transient-shaped): for a
+                # job-level cancel the scheduler drops it; for a drain
+                # the job is live and the task re-queues elsewhere.
+                log.info("task %s cancelled (%s)", pid, e.reason)
+                self.tasks_cancelled += 1
+                self._query_log.record({
+                    "task": pid.key(), "state": "cancelled",
+                    "status": "cancelled",
+                    "wall_seconds": round(time.time() - t0, 4),
+                    "cancel_reason": e.reason,
+                })
+                self._report_failed(pid, f"{type(e).__name__}: {e}",
+                                    td.stage_version)
+                # a JOB-level cancel removes the job's outputs (the
+                # poll-side cleanup may have run before this task
+                # released its write handle). A drain must NOT: the job
+                # is live and this executor's earlier completed stage
+                # files may still be fetched while the drain grace runs
+                if e.reason != "drain":
+                    self._cleanup_job_outputs(pid.job_id)
             except Exception as e:  # noqa: BLE001 - task failure
                 log.exception("task %s failed", pid)
                 self.tasks_failed += 1
@@ -329,6 +550,8 @@ class Executor:
                 self._report_failed(pid, f"{type(e).__name__}: {e}",
                                     td.stage_version)
             finally:
+                with self._token_lock:
+                    self._task_tokens.pop(pid.key(), None)
                 self._inflight -= 1
                 self._slots.release()
 
@@ -350,7 +573,13 @@ class Executor:
         # an aborted task leaves behind are cancelled, never leaked
         prime_plan(plan, partitions=[pid.partition_id])
         try:
-            batches = list(plan.execute(pid.partition_id))
+            batches = []
+            for batch in plan.execute(pid.partition_id):
+                # cooperative cancellation at the batch boundary: a
+                # fired token (job cancel, drain) stops the pull here;
+                # cancel_plan below unparks the ingest producers
+                check_cancel()
+                batches.append(batch)
         finally:
             # handles the plan never consumed (limit short-circuits,
             # failures) must not leave producers parked on full queues

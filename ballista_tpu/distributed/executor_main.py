@@ -142,10 +142,15 @@ def main(argv=None) -> int:
         print(f"ballista-tpu executor health plane on "
               f"127.0.0.1:{executor.health_port}", flush=True)
     stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
-    print(f"signal {stop}; shutting down", flush=True)
+    drain = stop == signal.SIGTERM
+    print(f"signal {stop}; shutting down"
+          + (" (graceful drain)" if drain else ""), flush=True)
     if leader is not None:
         leader.close()
-    executor.stop()
+    # SIGTERM (the orchestrator's polite stop) drains: stop accepting,
+    # let in-flight tasks finish within the bound, flush pending status
+    # reports. SIGINT (ctrl-C) keeps the immediate shutdown.
+    executor.stop(drain=drain)
     return 0
 
 

@@ -28,6 +28,7 @@ from ..errors import ClusterError
 from ..execution import plan_logical
 from ..observability import trace_span
 from ..proto import ballista_pb2 as pb
+from ..testing.faults import fault_point
 from .. import serde
 from .planner import (
     DistributedPlanner,
@@ -304,6 +305,7 @@ class SchedulerService:
             ("ballista_jobs_submitted_total", {}, st.jobs_submitted),
             ("ballista_jobs_completed_total", {}, st.jobs_completed),
             ("ballista_jobs_failed_total", {}, st.jobs_failed),
+            ("ballista_jobs_cancelled_total", {}, st.jobs_cancelled),
             ("ballista_tasks_dispatched_total", {}, self.tasks_dispatched),
             ("ballista_ready_queue_depth", {}, st.ready_queue_depth()),
             ("ballista_slow_queries_total", {}, st.query_log.slow_total),
@@ -458,6 +460,12 @@ class SchedulerService:
     def ExecuteQuery(self, request: pb.ExecuteQueryParams, context=None):
         job_id = _job_id()
         settings = dict(request.settings)
+        if request.deadline_secs > 0:
+            # server-side deadline: armed BEFORE planning (a stuck plan
+            # counts) and enforced by the PollWork reap pass, so the job
+            # dies on time even when the submitting client is gone
+            self.state.save_job_deadline(
+                job_id, time.time() + request.deadline_secs)
         if request.WhichOneof("query") == "logical_plan":
             plan = serde.plan_from_proto(request.logical_plan)
             args = (job_id, plan, settings, None, None)
@@ -513,7 +521,10 @@ class SchedulerService:
                                      catalog_entries)
         except Exception as e:  # noqa: BLE001 - job-level failure
             log.exception("planning failed for job %s", job_id)
-            self.state.save_job_status(job_id, JobStatus("failed", error=str(e)))
+            if not self.state.is_job_cancelled(job_id):
+                # a cancel that raced planning stays terminal-cancelled
+                self.state.save_job_status(
+                    job_id, JobStatus("failed", error=str(e)))
 
     def _plan_job_inner(self, job_id: str, logical_plan, settings=None,
                         sql=None, catalog_entries=None):
@@ -563,6 +574,12 @@ class SchedulerService:
                 self.state.save_task_status(
                     TaskStatus(PartitionId(job_id, stage.stage_id, p))
                 )
+        if self.state.is_job_cancelled(job_id):
+            # cancelled while planning (client cancel or an expired
+            # deadline): nothing may reach the ready queue
+            log.info("job %s cancelled during planning; not enqueued",
+                     job_id)
+            return
         self.state.enqueue_job(job_id)
         log.info(
             "planned job %s into %d stages in %.0fms",
@@ -572,6 +589,8 @@ class SchedulerService:
     # -- RPC: PollWork ------------------------------------------------------
 
     def PollWork(self, request: pb.PollWorkParams, context=None):
+        fault_point("scheduler.poll_work",
+                    executor=request.metadata.id[:8])
         res = None
         if request.metadata.HasField("resources"):
             r = request.metadata.resources
@@ -591,7 +610,24 @@ class SchedulerService:
         )
         self.state.save_executor_metadata(meta)
         jobs_touched = set(self.state.reap_lost_tasks())
+        # lifecycle reap: expired server-side deadlines + the slow-query
+        # killer (already-terminal, so not re-synchronized below)
+        self.state.reap_expired_jobs()
+        # late reports from tasks of a cancelled job: the terminal state
+        # stands — no recovery, no re-queue, and a completion must not
+        # resurrect dependents. Memoized per request: is_job_cancelled
+        # falls back to a KV read, and a poll's reports almost always
+        # share one job — don't pay one read per report on the hottest
+        # handler
+        _cancel_memo: dict = {}
         for ts in request.task_status:
+            jid = ts.partition_id.job_id
+            cancelled = _cancel_memo.get(jid)
+            if cancelled is None:
+                cancelled = _cancel_memo[jid] = \
+                    self.state.is_job_cancelled(jid)
+            if cancelled:
+                continue
             if ts.WhichOneof("status") == "completed" and \
                     ts.completed.HasField("profile"):
                 # distributed profiler: the task's profile window is
@@ -670,6 +706,9 @@ class SchedulerService:
                     if not self.state.recover_fetch_failure(st):
                         self.state.save_task_status(st)
                     jobs_touched.add(task.job_id)
+        # piggyback recently-cancelled job ids: executors abort matching
+        # running tasks at batch boundaries and clean partial outputs
+        result.cancelled_jobs.extend(self.state.cancelled_job_ids())
         for job_id in jobs_touched:
             self.state.synchronize_job_status(job_id)
         return result
@@ -728,9 +767,28 @@ class SchedulerService:
             td.shuffle_output_partitions = n_out
         return td
 
+    # -- RPC: CancelJob -----------------------------------------------------
+
+    def CancelJob(self, request: pb.CancelJobParams, context=None):
+        """Cooperative cancellation entry point: move the job to its
+        terminal Cancelled state and drop its queued tasks. Running
+        tasks abort at batch boundaries once their executor's next poll
+        carries the id (PollWorkResult.cancelled_jobs)."""
+        cancelled = self.state.cancel_job(request.job_id,
+                                          request.reason or "client")
+        st = self.state.get_job_status(request.job_id)
+        return pb.CancelJobResult(
+            cancelled=cancelled,
+            state=st.state if st is not None else "unknown",
+        )
+
     # -- RPC: GetJobStatus --------------------------------------------------
 
     def GetJobStatus(self, request: pb.GetJobStatusParams, context=None):
+        # lifecycle reap rides status polls too: with every executor
+        # down there are no PollWork calls, but a waiting client still
+        # drives deadline/slow-query-kill enforcement for its job
+        self.state.reap_expired_jobs()
         st = self.state.get_job_status(request.job_id)
         result = pb.GetJobStatusResult()
         if st is None:
@@ -739,6 +797,9 @@ class SchedulerService:
             result.status.queued.SetInParent()
         elif st.state == "running":
             result.status.running.SetInParent()
+        elif st.state == "cancelled":
+            result.status.cancelled.reason = \
+                getattr(st, "cancel_reason", None) or "unknown"
         elif st.state == "failed":
             result.status.failed.error = st.error or "unknown error"
         else:
@@ -895,6 +956,7 @@ def _task_status_from_proto(ts: pb.TaskStatus) -> TaskStatus:
 _RPCS = {
     "ExecuteQuery": (pb.ExecuteQueryParams, pb.ExecuteQueryResult),
     "PollWork": (pb.PollWorkParams, pb.PollWorkResult),
+    "CancelJob": (pb.CancelJobParams, pb.CancelJobResult),
     "GetJobStatus": (pb.GetJobStatusParams, pb.GetJobStatusResult),
     "GetJobProfile": (pb.GetJobProfileParams, pb.GetJobProfileResult),
     "GetSystemTable": (pb.GetSystemTableParams, pb.GetSystemTableResult),
@@ -947,7 +1009,15 @@ class SchedulerClient:
 
     def __getattr__(self, name):
         if name in _RPCS:
-            return self._stubs[name]
+            stub = self._stubs[name]
+
+            def call(request, _stub=stub, _name=name):
+                # client-side fault point: a triggered failure surfaces
+                # as an RPC error exactly where a flaky network would
+                fault_point("client.rpc", method=_name)
+                return _stub(request)
+
+            return call
         raise AttributeError(name)
 
     def close(self):

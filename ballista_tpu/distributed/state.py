@@ -25,6 +25,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..errors import ClusterError
+from ..testing.faults import fault_point
 from .types import (
     ExecutorMeta,
     JobStatus,
@@ -212,7 +213,16 @@ class SchedulerState:
         self.jobs_submitted = 0
         self.jobs_completed = 0
         self.jobs_failed = 0
+        self.jobs_cancelled = 0
         self._job_started: Dict[str, float] = {}
+        # lifecycle control plane: recently-cancelled job ids (piggy-
+        # backed on PollWorkResult until they age out), server-side
+        # deadlines (absolute wall times; in-memory — a restarted
+        # scheduler re-queues work but drops pending deadlines), and
+        # the deadline-scan throttle stamp — all guarded by self._lock
+        self._cancelled_jobs: Dict[str, float] = {}
+        self._job_deadlines: Dict[str, float] = {}
+        self._last_deadline_scan = 0.0
         # distributed profiler: per-job logical-plan digests (so a slow
         # query is identifiable after the fact without re-planning) and
         # the terminal-transition hook the scheduler service installs —
@@ -246,7 +256,8 @@ class SchedulerState:
                 jobs.add(job_id)
             for job_id in jobs:
                 js = self.get_job_status(job_id)
-                if js is not None and js.state in ("completed", "failed"):
+                if js is not None and js.state in ("completed", "failed",
+                                                   "cancelled"):
                     continue
                 for sid in self.stage_ids(job_id):
                     deps = self._stage_deps.get((job_id, sid), [])
@@ -298,11 +309,15 @@ class SchedulerState:
         if status.state == "queued":
             self.jobs_submitted += 1
             self._job_started.setdefault(job_id, time.time())
-        elif status.state in ("completed", "failed"):
+        elif status.state in ("completed", "failed", "cancelled"):
+            with self._lock:
+                self._job_deadlines.pop(job_id, None)
             t0 = self._job_started.pop(job_id, None)
             if t0 is not None:
                 if status.state == "completed":
                     self.jobs_completed += 1
+                elif status.state == "cancelled":
+                    self.jobs_cancelled += 1
                 else:
                     self.jobs_failed += 1
                 # ONE record shape for every surface (/debug/queries,
@@ -329,6 +344,7 @@ class SchedulerState:
                     num_stages=len(self.stage_ids(job_id)),
                     started_at=t0,
                     error=status.error,
+                    cancel_reason=getattr(status, "cancel_reason", None),
                     origin="cluster",
                 )
                 if self.profile_hook is not None:
@@ -365,6 +381,95 @@ class SchedulerState:
     def get_job_settings(self, job_id: str) -> Dict[str, str]:
         v = self.kv.get(self._k("jobconf", job_id))
         return pickle.loads(v) if v is not None else {}
+
+    # -- job lifecycle: cancellation + deadlines -----------------------------
+    # The reference cannot stop work at all (no CancelJob; a client
+    # timeout only stops WAITING). Cancellation here is cooperative:
+    # the job moves to a terminal Cancelled state, its queued tasks are
+    # dropped, and executors learn via the PollWorkResult piggyback to
+    # abort running tasks at batch boundaries.
+
+    # how long a cancelled job id keeps riding PollWorkResult: every
+    # executor polls multiple times within this window, so each sees
+    # the cancel at least once even across a scheduler hiccup
+    CANCEL_BROADCAST_SECS = 60.0
+
+    def cancel_job(self, job_id: str, reason: str = "client") -> bool:
+        """Move the job to terminal ``cancelled`` (idempotent: False
+        when unknown or already terminal), drop its queued tasks, and
+        start broadcasting the id to polling executors."""
+        with self._lock:
+            status = self.get_job_status(job_id)
+            if status is None or status.state in ("completed", "failed",
+                                                  "cancelled"):
+                return False
+            self._cancelled_jobs[job_id] = time.time()
+            # queued tasks stop here; running ones abort executor-side
+            self._ready = [p for p in self._ready if p.job_id != job_id]
+            self.save_job_status(job_id, JobStatus(
+                "cancelled", error=f"cancelled ({reason})",
+                cancel_reason=reason,
+            ))
+        log.warning("cancelled job %s (%s)", job_id, reason)
+        from ..observability.tracing import trace_event
+
+        trace_event("lifecycle.cancel", job=job_id, reason=reason)
+        return True
+
+    def is_job_cancelled(self, job_id: str) -> bool:
+        with self._lock:
+            if job_id in self._cancelled_jobs:
+                return True
+        # a restarted scheduler loses the in-memory set but not the KV
+        status = self.get_job_status(job_id)
+        return status is not None and status.state == "cancelled"
+
+    def cancelled_job_ids(self) -> List[str]:
+        """Recently-cancelled job ids for the PollWorkResult piggyback
+        (pruned past CANCEL_BROADCAST_SECS so the list stays bounded)."""
+        now = time.time()
+        with self._lock:
+            stale = [j for j, t in self._cancelled_jobs.items()
+                     if now - t > self.CANCEL_BROADCAST_SECS]
+            for j in stale:
+                del self._cancelled_jobs[j]
+            return sorted(self._cancelled_jobs)
+
+    def save_job_deadline(self, job_id: str, deadline_ts: float):
+        """Absolute wall time after which reap_expired_jobs cancels the
+        job (server-side: holds even when the client is gone)."""
+        with self._lock:
+            self._job_deadlines[job_id] = float(deadline_ts)
+
+    def get_job_deadline(self, job_id: str) -> Optional[float]:
+        with self._lock:
+            return self._job_deadlines.get(job_id)
+
+    def reap_expired_jobs(self, min_interval_secs: float = 1.0
+                          ) -> List[str]:
+        """Cancel jobs past their server-side deadline, and — when
+        ``BALLISTA_SLOW_QUERY_KILL_SECS`` is set — jobs running longer
+        than the kill threshold (upgrading the slow-query LOG to a
+        kill). Runs from the PollWork reap pass, throttled. Returns the
+        job ids it cancelled."""
+        now = time.time()
+        with self._lock:
+            if now - self._last_deadline_scan < min_interval_secs:
+                return []
+            self._last_deadline_scan = now
+            expired = [j for j, dl in self._job_deadlines.items()
+                       if now > dl]
+        touched = [j for j in expired if self.cancel_job(j, "deadline")]
+        from ..observability.health import slow_query_kill_secs
+
+        kill = slow_query_kill_secs()
+        if kill is not None:
+            overdue = [j for j, t0 in list(self._job_started.items())
+                       if now - t0 >= kill]
+            touched.extend(
+                j for j in overdue
+                if self.cancel_job(j, "slow-query-kill"))
+        return touched
 
     # -- stages -------------------------------------------------------------
 
@@ -540,6 +645,7 @@ class SchedulerState:
     # -- tasks --------------------------------------------------------------
 
     def save_task_status(self, st: TaskStatus):
+        fault_point("state.save", task=st.partition.key())
         self.kv.put(
             self._k("tasks", st.partition.job_id, st.partition.stage_id,
                     st.partition.partition_id),
@@ -570,7 +676,11 @@ class SchedulerState:
     def _enqueue_stage(self, job_id: str, stage_id: int):
         """Enqueue the stage's PENDING tasks (state None) that are not
         already queued — idempotent, so recovery can re-trigger it after
-        resetting lost tasks without double-running live ones."""
+        resetting lost tasks without double-running live ones. A
+        cancelled job enqueues nothing (recovery/completion paths may
+        still fire for late reports)."""
+        if job_id in self._cancelled_jobs:
+            return
         n = self._stage_parts[(job_id, stage_id)]
         started = {
             t.partition.partition_id
@@ -594,6 +704,11 @@ class SchedulerState:
         mesh-fused stage's tasks only go to executors reporting at least
         that many devices (0 = caller capacity unknown, accept any)."""
         with self._lock:
+            # purge tasks of cancelled jobs first: a stage completion
+            # racing the cancel may have re-enqueued some
+            if self._cancelled_jobs:
+                self._ready = [p for p in self._ready
+                               if p.job_id not in self._cancelled_jobs]
             for i, pid in enumerate(self._ready):
                 need = self._stage_mesh.get((pid.job_id, pid.stage_id), 0)
                 if need and num_devices and num_devices < need:
@@ -782,7 +897,15 @@ class SchedulerState:
     # capacity limits — fail fast like the reference
     TRANSIENT_ERRORS = ("IoError:", "OSError:", "ConnectionError:",
                         "ConnectionResetError:", "ConnectionRefusedError:",
-                        "TimeoutError:", "BrokenPipeError:")
+                        "TimeoutError:", "BrokenPipeError:",
+                        # injected faults deliberately look transient so
+                        # the chaos sweep exercises the retry budget
+                        "FaultInjected:",
+                        # a DRAINING executor cancels its in-flight
+                        # tasks; the job is still live — re-queue them
+                        # (job-cancel reports never reach here: PollWork
+                        # drops reports for cancelled jobs)
+                        "QueryCancelled:")
 
     def recover_transient_failure(self, st: TaskStatus) -> bool:
         """Re-queue a task that failed with an IO-shaped (transient)
@@ -911,8 +1034,11 @@ class SchedulerState:
 
     def synchronize_job_status(self, job_id: str):
         status = self.get_job_status(job_id)
-        if status is None or status.state in ("completed", "failed"):
+        if status is None or status.state in ("completed", "failed",
+                                              "cancelled"):
             return
+        if self.is_job_cancelled(job_id):
+            return  # cancel marked but terminal save still in flight
         tasks = self.get_task_statuses(job_id)
         if not tasks:
             return

@@ -82,8 +82,12 @@ class StagePlan:
 
 @dataclass
 class JobStatus:
-    state: str  # queued|running|completed|failed
+    state: str  # queued|running|completed|failed|cancelled
     error: Optional[str] = None
     partition_locations: Optional[list] = None
     # stage_id -> aggregated task metrics (filled when completed)
     stage_metrics: Optional[dict] = None
+    # terminal "cancelled" provenance: client|timeout|deadline|
+    # slow-query-kill|drain (read with getattr — durable backends may
+    # hold pickles from before the field existed)
+    cancel_reason: Optional[str] = None

@@ -41,7 +41,33 @@ class IoError(BallistaError):
 
 
 class ClusterError(BallistaError):
-    """Scheduler/executor control-plane failure."""
+    """Scheduler/executor control-plane failure. Carries the job id
+    when one is known (e.g. a client-side timeout), so the caller can
+    inspect the job in ``system.queries`` after the fact."""
+
+    def __init__(self, message: str, job_id: "str | None" = None):
+        super().__init__(message)
+        self.job_id = job_id
+
+
+class QueryCancelled(BallistaError):
+    """A query was cooperatively cancelled (client CancelJob, server
+    deadline, slow-query kill, or executor drain). Terminal but NOT a
+    failure: surfaces record status ``cancelled`` with the reason."""
+
+    def __init__(self, reason: str = "client",
+                 job_id: "str | None" = None):
+        self.reason = reason
+        self.job_id = job_id
+        suffix = f" [job {job_id}]" if job_id else ""
+        super().__init__(f"query cancelled ({reason}){suffix}")
+
+
+class FaultInjected(IoError):
+    """Raised by an armed fault point (testing/faults.py). Subclasses
+    IoError so injected task failures look transient to the scheduler's
+    recovery (``FaultInjected:`` is in TRANSIENT_ERRORS) and exercise
+    the retry-budget machinery exactly like a real IO hiccup."""
 
 
 class ShuffleFetchError(IoError):

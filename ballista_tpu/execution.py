@@ -131,10 +131,14 @@ def collect_physical(phys: PhysicalPlan) -> Dict[str, np.ndarray]:
     preserved — see ingest.iter_partitions); serial when the pipeline
     is gated off."""
     from .ingest import iter_partitions
+    from .lifecycle import check_cancel
 
     parts: List[Dict[str, np.ndarray]] = []
     for batch in iter_partitions(
             phys, range(phys.output_partitioning().num_partitions)):
+        # cooperative cancellation: a fired token (ctx.cancel, the
+        # slow-query killer) stops the collect at a batch boundary
+        check_cancel()
         parts.append(batch.to_pydict())
     if not parts:
         return {f.name: np.asarray([]) for f in phys.output_schema().fields}

@@ -265,10 +265,17 @@ def iter_partitions(plan, partitions) -> "Iterable":
     instance-level materializations shared ACROSS partitions —
     JoinExec's merged build, RepartitionExec's parts — take per-
     instance locks."""
+    from ..lifecycle import check_cancel
+
     parts = list(partitions)
     if prefetch_batches() <= 0 or ingest_threads() <= 1 or len(parts) <= 1:
         for p in parts:
-            yield from plan.execute(p)
+            for batch in plan.execute(p):
+                # cooperative cancellation at the batch boundary (the
+                # consumer thread carries the token; producers are
+                # unparked by cancel_plan once this raises)
+                check_cancel()
+                yield batch
         return
     # STAGGERED: partition 0 runs inline first, so every governed
     # program in the subtree traces/lowers exactly once (concurrent
@@ -276,7 +283,9 @@ def iter_partitions(plan, partitions) -> "Iterable":
     # pure GIL-bound Python — turning the overlap into a slowdown on a
     # cold plan); the remaining partitions then overlap with the traces
     # warm, where their time is genuinely XLA execution (GIL released).
-    yield from plan.execute(parts[0])
+    for batch in plan.execute(parts[0]):
+        check_cancel()
+        yield batch
     handles = [
         PrefetchHandle(lambda p=p: plan.execute(p), prefetch_batches(),
                        label=f"partition[{p}]")
@@ -284,7 +293,9 @@ def iter_partitions(plan, partitions) -> "Iterable":
     ]
     try:
         for h in handles:
-            yield from h
+            for batch in h:
+                check_cancel()
+                yield batch
     finally:
         for h in handles:
             h.cancel()

@@ -52,6 +52,20 @@ def slow_query_secs() -> Optional[float]:
         return None
 
 
+def slow_query_kill_secs() -> Optional[float]:
+    """``BALLISTA_SLOW_QUERY_KILL_SECS``: upgrade the slow-query LOG to
+    a KILL — the scheduler's reap pass cancels cluster jobs running
+    longer than this, and standalone collects arm a watchdog that fires
+    the query's cancel token. None when unset/invalid."""
+    v = os.environ.get("BALLISTA_SLOW_QUERY_KILL_SECS", "")
+    if not v:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        return None
+
+
 class QueryLog:
     """Bounded ring of recent query summaries + the slow subset.
 

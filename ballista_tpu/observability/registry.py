@@ -93,6 +93,14 @@ PROCESS_METRICS = {
                                                  "ExecuteQuery"),
     "ballista_jobs_completed_total": ("counter", "jobs completed"),
     "ballista_jobs_failed_total": ("counter", "jobs failed"),
+    "ballista_jobs_cancelled_total": ("counter", "jobs cooperatively "
+                                                 "cancelled (client, "
+                                                 "deadline, slow-query "
+                                                 "kill, drain)"),
+    "ballista_tasks_cancelled_total": ("counter", "task attempts aborted "
+                                                  "by a cancel token "
+                                                  "(job cancel or "
+                                                  "executor drain)"),
     "ballista_tasks_dispatched_total": ("counter", "task definitions "
                                                    "handed to executors"),
     "ballista_ready_queue_depth": ("gauge", "tasks in the ready queue"),

@@ -142,6 +142,25 @@ KNOBS: Dict[str, tuple] = {
     "BALLISTA_QUERY_LOG_MAX_MB": ("16", "rotate the query-history log "
                                         "past this size (one rotated "
                                         "segment is kept)"),
+    # query lifecycle control plane (docs/robustness.md)
+    "BALLISTA_SLOW_QUERY_KILL_SECS": ("off", "upgrade the slow-query log "
+                                             "to a KILL: cancel queries "
+                                             "running longer than this "
+                                             "(both paths)"),
+    "BALLISTA_CANCEL_ON_TIMEOUT": ("on", "a client-side job timeout "
+                                         "issues a best-effort CancelJob "
+                                         "before raising (off = old "
+                                         "abandon-the-job behavior)"),
+    "BALLISTA_DRAIN_TIMEOUT_SECS": ("20", "graceful drain bound: "
+                                          "in-flight tasks get this long "
+                                          "to finish before being "
+                                          "cancelled"),
+    "BALLISTA_FAULTS": ("off", "deterministic fault injection spec "
+                               "(point=trigger[;...]; see "
+                               "docs/robustness.md)"),
+    "BALLISTA_POLL_BACKOFF_MAX_SECS": ("8", "executor poll-loop backoff "
+                                            "ceiling while the scheduler "
+                                            "is unreachable"),
 }
 
 # dynamic env-name families: read via computed names, documented as
@@ -190,7 +209,8 @@ SYSTEM_SCHEMAS: Dict[str, Schema] = {
         ("started_at", Float64), ("wall_seconds", Float64),
         ("output_rows", Int64), ("num_stages", Int64),
         ("peak_host_bytes", Int64), ("peak_device_bytes", Int64),
-        ("profile_artifact", Utf8), ("error", Utf8), ("origin", Utf8),
+        ("profile_artifact", Utf8), ("error", Utf8),
+        ("cancel_reason", Utf8), ("origin", Utf8),
     ),
     "system.query_lanes": make_schema(
         ("job_id", Utf8), ("plan_digest", Utf8), ("lane", Utf8),
@@ -239,6 +259,7 @@ def build_query_record(job_id: str, status: str, wall_seconds: float,
                        peak_device_bytes: Optional[int] = None,
                        profile_artifact: Optional[str] = None,
                        error: Optional[str] = None,
+                       cancel_reason: Optional[str] = None,
                        lanes: Optional[dict] = None,
                        origin: str = "standalone") -> dict:
     """The canonical query summary dict: what the /debug/queries ring,
@@ -268,6 +289,8 @@ def build_query_record(job_id: str, status: str, wall_seconds: float,
         rec["profile_artifact"] = profile_artifact
     if error:
         rec["error"] = str(error)[:300]
+    if cancel_reason:
+        rec["cancel_reason"] = str(cancel_reason)
     if lanes:
         rec["lanes"] = {k: float(v) for k, v in lanes.items()}
     return rec
@@ -590,6 +613,15 @@ class StandaloneQueryRecorder:
             lanes = self._lanes(wall)
         except Exception:  # noqa: BLE001 - lanes are advisory
             lanes = None
+        # a cooperatively-cancelled query is terminal "cancelled", not a
+        # failure; the reason (client/deadline/slow-query-kill/drain)
+        # rides the record so system.queries can answer "who killed it"
+        cancel_reason = None
+        from ..errors import QueryCancelled
+
+        if isinstance(error, QueryCancelled):
+            status = "cancelled"
+            cancel_reason = error.reason
         rec = build_query_record(
             self.job_id, status, wall,
             plan_digest=self.digest,
@@ -600,6 +632,7 @@ class StandaloneQueryRecorder:
             peak_device_bytes=obs_memory.peak_device_bytes(),
             profile_artifact=self.artifact_path,
             error=error,
+            cancel_reason=cancel_reason,
             lanes=lanes,
             origin="standalone",
         )
@@ -644,6 +677,7 @@ def _queries_rows(query_log) -> List[dict]:
             "peak_device_bytes": rec.get("peak_device_bytes"),
             "profile_artifact": rec.get("profile_artifact"),
             "error": rec.get("error"),
+            "cancel_reason": rec.get("cancel_reason"),
             "origin": rec.get("origin"),
         })
     return rows

@@ -288,7 +288,9 @@ class ShuffleReaderExec(PhysicalPlan):
 
         from ..distributed.dataplane import fetch_partition_bytes
         from ..errors import ShuffleFetchError
+        from ..lifecycle import check_cancel
         from ..observability import trace_span
+        from ..testing.faults import fault_point
 
         if not loc.host or not loc.port:
             raise ShuffleFetchError(
@@ -297,6 +299,8 @@ class ShuffleReaderExec(PhysicalPlan):
             )
         last = None
         for attempt in range(2):
+            # a cancelled task must stop fetching, not ride out retries
+            check_cancel()
             try:
                 # 10s covers connect and each recv (not the whole
                 # transfer); a dead-but-backlogged peer fails fast
@@ -304,6 +308,12 @@ class ShuffleReaderExec(PhysicalPlan):
                                 stage=loc.stage_id,
                                 partition=loc.partition_id,
                                 attempt=attempt):
+                    # per-attempt: an injected failure is retried like a
+                    # real transport hiccup, then surfaces as the tagged
+                    # ShuffleFetchError the scheduler re-queues on
+                    fault_point("shuffle.fetch", stage=loc.stage_id,
+                                partition=loc.partition_id,
+                                attempt=attempt)
                     return fetch_partition_bytes(
                         loc.host, loc.port, loc.job_id, loc.stage_id,
                         loc.partition_id, shuffle_output=loc.shuffle_output,

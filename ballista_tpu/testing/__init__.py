@@ -1,0 +1,6 @@
+"""Test-support machinery that ships with the engine.
+
+``testing.faults`` is imported from production code paths (the fault
+points are compiled in, inert by default), so this package is part of
+the library proper — not of tests/.
+"""

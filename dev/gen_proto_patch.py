@@ -219,6 +219,34 @@ def apply_systables(fdp: dp.FileDescriptorProto) -> None:
         add_field(m, "error", 2, F.TYPE_STRING)
 
 
+def apply_lifecycle(fdp: dp.FileDescriptorProto) -> None:
+    """PR 9: query lifecycle control plane (mirrored by hand in
+    ballista.proto; dev/check_proto_sync.py guards the drift) — the
+    CancelJob RPC messages, the terminal CancelledJob status, the
+    server-side deadline on ExecuteQueryParams, and the cancelled-job
+    piggyback on PollWorkResult."""
+    if not has_message(fdp, "CancelledJob"):
+        m = fdp.message_type.add(name="CancelledJob")
+        add_field(m, "reason", 1, F.TYPE_STRING)
+    add_field(get_message(fdp, "JobStatus"), "cancelled", 5,
+              F.TYPE_MESSAGE, type_name=".ballista_tpu.CancelledJob",
+              oneof="status")
+
+    add_field(get_message(fdp, "PollWorkResult"), "cancelled_jobs", 2,
+              F.TYPE_STRING, repeated=True)
+    add_field(get_message(fdp, "ExecuteQueryParams"), "deadline_secs", 5,
+              F.TYPE_DOUBLE)
+
+    if not has_message(fdp, "CancelJobParams"):
+        m = fdp.message_type.add(name="CancelJobParams")
+        add_field(m, "job_id", 1, F.TYPE_STRING)
+        add_field(m, "reason", 2, F.TYPE_STRING)
+    if not has_message(fdp, "CancelJobResult"):
+        m = fdp.message_type.add(name="CancelJobResult")
+        add_field(m, "cancelled", 1, F.TYPE_BOOL)
+        add_field(m, "state", 2, F.TYPE_STRING)
+
+
 TEMPLATE = '''# -*- coding: utf-8 -*-
 # Generated by dev/gen_proto_patch.py (no protoc in this image). DO NOT EDIT!
 # source: ballista.proto
@@ -250,6 +278,7 @@ def main() -> None:
     apply_health(fdp)
     apply_profiler(fdp)
     apply_systables(fdp)
+    apply_lifecycle(fdp)
     out = TEMPLATE.format(blob=fdp.SerializeToString())
     with open(PB2, "w") as f:
         f.write(out)

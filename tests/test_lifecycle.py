@@ -1,0 +1,821 @@
+"""Query lifecycle control plane: cooperative cancellation, server-side
+deadlines, graceful drain — proven by deterministic fault injection.
+
+The reference cannot STOP work at all: no CancelJob RPC, a client
+timeout only stops waiting, and killing an executor abandons tasks
+mid-flight (SURVEY.md:336-343 "no task retry, no recovery, no fault
+injection"). These tests pin the whole lifecycle: cancel mid-stage
+frees slots and leaves the cluster reusable, server-side deadlines and
+the slow-query killer reap runaway jobs, a draining executor never
+loses completion reports, and the standalone path cancels at batch
+boundaries. The chaos sweep at the bottom drives every recovery
+behavior through the NAMED fault points in testing/faults.py — the
+deterministic replacement for hand-crafted failure setups.
+
+Style: service-level tests use direct calls + manually pumped
+executors like test_recovery.py; e2e gates run a real LocalCluster.
+"""
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ballista_tpu import Int64, Utf8, col, schema, serde, sum_
+from ballista_tpu.client import BallistaContext
+from ballista_tpu.distributed.executor import (
+    Executor,
+    ExecutorConfig,
+    LocalCluster,
+)
+from ballista_tpu.distributed.scheduler import (
+    SchedulerService,
+    serve_scheduler,
+)
+from ballista_tpu.distributed.state import MemoryBackend, SchedulerState
+from ballista_tpu.distributed.types import JobStatus, PartitionId
+from ballista_tpu.errors import (
+    ClusterError,
+    FaultInjected,
+    QueryCancelled,
+)
+from ballista_tpu.io.memory import MemTableSource
+from ballista_tpu.logical import LogicalPlanBuilder
+from ballista_tpu.physical.shuffle import ShuffleReaderExec
+from ballista_tpu.proto import ballista_pb2 as pb
+from ballista_tpu.testing.faults import (
+    FaultConfigError,
+    fault_point,
+    parse_spec,
+    reload_faults,
+)
+from ballista_tpu.testing import faults as faults_mod
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def faults_env():
+    """Arm BALLISTA_FAULTS for the test; disarm + restore afterwards."""
+    saved = os.environ.get("BALLISTA_FAULTS")
+
+    def arm(spec: str):
+        if spec:
+            os.environ["BALLISTA_FAULTS"] = spec
+        else:
+            os.environ.pop("BALLISTA_FAULTS", None)
+        reload_faults()
+
+    yield arm
+    if saved is None:
+        os.environ.pop("BALLISTA_FAULTS", None)
+    else:
+        os.environ["BALLISTA_FAULTS"] = saved
+    reload_faults()
+
+
+def _wait_until(cond, timeout: float, msg: str):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(msg)
+
+
+TSCHEMA = schema(("a", Int64), ("c", Utf8))
+GROUPBY_SQL = "select c, sum(a) as s from t group by c order by c"
+N_ROWS = 120
+
+
+def _write_tbl(tmp_path, rows: int = N_ROWS, parts: int = 2) -> str:
+    d = tmp_path / "t"
+    d.mkdir()
+    for part in range(parts):
+        lines = [f"{i}|k{i % 7}|" for i in range(rows) if i % parts == part]
+        (d / f"part{part}.tbl").write_text("\n".join(lines) + "\n")
+    return str(d)
+
+
+def _expected(rows: int = N_ROWS) -> pd.DataFrame:
+    df = pd.DataFrame({"a": range(rows),
+                       "c": [f"k{i % 7}" for i in range(rows)]})
+    out = (df.groupby("c", as_index=False)["a"].sum()
+           .rename(columns={"a": "s"})
+           .sort_values("c").reset_index(drop=True))
+    return out
+
+
+def _assert_identical(got: pd.DataFrame, exp: pd.DataFrame):
+    """Byte-identical: exact values, no float tolerance."""
+    assert list(got.columns) == list(exp.columns)
+    assert len(got) == len(exp)
+    for name in exp.columns:
+        g, e = got[name].to_numpy(), exp[name].to_numpy()
+        assert np.array_equal(g, e), f"column {name}: {g} != {e}"
+
+
+def _remote_ctx(cluster, **extra) -> BallistaContext:
+    settings = {"job.timeout": "60"}
+    settings.update(extra)
+    return BallistaContext("remote", "localhost", cluster.port,
+                          settings=settings)
+
+
+def _source(tmp_path):
+    """Two partition files -> a 2-task producer stage (recovery-test
+    idiom)."""
+    from ballista_tpu.io import TblSource
+
+    return TblSource(_write_tbl(tmp_path), TSCHEMA)
+
+
+def _submit_groupby(svc, src, deadline_secs: float = 0.0) -> str:
+    plan = (
+        LogicalPlanBuilder.scan("t", src)
+        .aggregate([col("c")], [sum_(col("a")).alias("s")])
+        .build()
+    )
+    params = pb.ExecuteQueryParams()
+    params.logical_plan.CopyFrom(serde.plan_to_proto(plan))
+    if deadline_secs:
+        params.deadline_secs = deadline_secs
+    job_id = svc.ExecuteQuery(params).job_id
+    deadline = time.time() + 10
+    while not svc.state.stage_ids(job_id):
+        assert time.time() < deadline, "planning never finished"
+        time.sleep(0.05)
+    while not svc.state._ready:
+        assert time.time() < deadline, "job never enqueued"
+        time.sleep(0.05)
+    return job_id
+
+
+def _pump(svc, executor, run=True):
+    """One manual poll cycle (recovery-test idiom). Returns the
+    PollWorkResult so callers can inspect cancelled_jobs."""
+    params = pb.PollWorkParams(can_accept_task=run)
+    params.metadata.id = executor.id
+    params.metadata.host = executor.config.host
+    params.metadata.port = executor.port
+    params.metadata.num_devices = 1
+    with executor._status_lock:
+        for st in executor._pending_status:
+            params.task_status.append(st)
+        executor._pending_status.clear()
+    result = svc.PollWork(params)
+    if run and result.HasField("task"):
+        td = result.task
+        pid = PartitionId(td.task_id.job_id, td.task_id.stage_id,
+                          td.task_id.partition_id)
+        plan = serde.physical_from_proto(td.plan)
+        shuffle = None
+        if td.shuffle_output_partitions:
+            hx = [serde.expr_from_proto(e) for e in td.shuffle_hash_exprs]
+            shuffle = (hx or None, td.shuffle_output_partitions)
+        try:
+            stats = executor.execute_partition(pid, plan, shuffle)
+            executor._report_completed(pid, stats)
+        except Exception as e:  # noqa: BLE001 - report like the real loop
+            executor._report_failed(pid, f"{type(e).__name__}: {e}")
+    return result
+
+
+class SlowSource(MemTableSource):
+    """A MemTableSource whose per-partition scan sleeps first — a
+    deterministic window for cooperative-cancellation tests (the
+    standalone collect checks its token at every batch boundary)."""
+
+    def __init__(self, inner: MemTableSource, delay_secs: float):
+        super().__init__(inner._schema, inner._partitions)
+        self._delay = delay_secs
+
+    def scan(self, partition, projection=None):
+        time.sleep(self._delay)
+        return super().scan(partition, projection)
+
+
+def _slow_ctx(delay_secs: float = 0.25, parts: int = 4) -> BallistaContext:
+    ctx = BallistaContext.standalone()
+    inner = MemTableSource.from_pydict(
+        TSCHEMA,
+        {"a": list(range(64)), "c": [f"k{i % 7}" for i in range(64)]},
+        num_partitions=parts,
+    )
+    ctx.register_source("t", SlowSource(inner, delay_secs))
+    return ctx
+
+
+# ---------------------------------------------------------------------------
+# (a) fault-injection layer: parsing, deterministic triggers, lint
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_parse_errors_are_loud():
+    with pytest.raises(FaultConfigError):
+        parse_spec("not.a.point=fail-once")  # unknown point
+    with pytest.raises(FaultConfigError):
+        parse_spec("shuffle.fetch=banana")  # unknown trigger
+    with pytest.raises(FaultConfigError):
+        parse_spec("garbage")  # malformed entry
+    with pytest.raises(FaultConfigError):
+        parse_spec("shuffle.fetch=fail-every:x")  # bad argument
+    rules = parse_spec("shuffle.fetch=fail-every:3 , client.rpc=delay:10")
+    assert set(rules) == {"shuffle.fetch", "client.rpc"}
+
+
+def test_fault_triggers_are_deterministic(faults_env):
+    # fail-once:K fires on exactly the Kth hit
+    faults_env("executor.task.start=fail-once:2")
+    assert fault_point("executor.task.start") is None
+    with pytest.raises(FaultInjected):
+        fault_point("executor.task.start")
+    assert fault_point("executor.task.start") is None
+
+    # fail-every:N fires on every Nth hit
+    faults_env("executor.task.start=fail-every:3")
+    fired = []
+    for _ in range(9):
+        try:
+            fault_point("executor.task.start")
+            fired.append(False)
+        except FaultInjected:
+            fired.append(True)
+    assert fired == [False, False, True] * 3
+
+    # drop returns the action for the caller to act on
+    faults_env("dataplane.serve=drop-once")
+    assert fault_point("dataplane.serve") == "drop"
+    assert fault_point("dataplane.serve") is None
+
+    # delay sleeps then reports
+    faults_env("state.save=delay:1")
+    assert fault_point("state.save") == "delay"
+
+    # disarmed: pure no-op
+    faults_env("")
+    assert fault_point("shuffle.fetch") is None
+
+
+def test_fault_points_lint_green():
+    """dev/check_fault_points.py: every literal call-site name is
+    registered and every registered point has a call site (tier-1, like
+    check_metric_names/check_knob_docs)."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "dev",
+                                      "check_fault_points.py")],
+        capture_output=True, text=True,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+# ---------------------------------------------------------------------------
+# (b) cancellation at the scheduler: terminal state, queue drop, piggyback
+# ---------------------------------------------------------------------------
+
+
+def test_cancel_drops_queued_tasks_and_is_terminal(tmp_path):
+    svc = SchedulerService(SchedulerState(MemoryBackend()))
+    job_id = _submit_groupby(svc, _source(tmp_path))
+    assert any(p.job_id == job_id for p in svc.state._ready)
+
+    res = svc.CancelJob(pb.CancelJobParams(job_id=job_id, reason="client"))
+    assert res.cancelled and res.state == "cancelled"
+    # queued tasks are gone; the terminal state carries the reason
+    assert all(p.job_id != job_id for p in svc.state._ready)
+    st = svc.state.get_job_status(job_id)
+    assert st.state == "cancelled" and st.cancel_reason == "client"
+
+    # idempotent: a second cancel reports the (unchanged) terminal state
+    res2 = svc.CancelJob(pb.CancelJobParams(job_id=job_id))
+    assert not res2.cancelled and res2.state == "cancelled"
+    # unknown job: no crash, state "unknown"
+    res3 = svc.CancelJob(pb.CancelJobParams(job_id="j-nope"))
+    assert not res3.cancelled and res3.state == "unknown"
+
+    # GetJobStatus speaks the cancelled oneof with the reason
+    gs = svc.GetJobStatus(pb.GetJobStatusParams(job_id=job_id))
+    assert gs.status.WhichOneof("status") == "cancelled"
+    assert gs.status.cancelled.reason == "client"
+
+
+def test_cancel_piggybacks_on_poll_and_late_reports_are_dropped(tmp_path):
+    svc = SchedulerService(SchedulerState(MemoryBackend()))
+    ex = Executor(ExecutorConfig(work_dir=str(tmp_path / "e1"),
+                                 scheduler_port=1))
+    try:
+        job_id = _submit_groupby(svc, _source(tmp_path))
+        # run one producer task to completion; its report is PENDING
+        res = _pump(svc, ex)
+        assert res.HasField("task")
+
+        assert svc.CancelJob(
+            pb.CancelJobParams(job_id=job_id, reason="client")).cancelled
+
+        # the next poll (delivering the now-late completion report)
+        # carries the cancelled id back; the report must NOT resurrect
+        # the job or its dependents
+        res2 = _pump(svc, ex, run=False)
+        assert job_id in list(res2.cancelled_jobs)
+        st = svc.state.get_job_status(job_id)
+        assert st.state == "cancelled"
+        # nothing re-queued for the cancelled job
+        assert all(p.job_id != job_id for p in svc.state._ready)
+    finally:
+        ex._data_plane.close()
+        ex._pool.shutdown(wait=False)
+
+
+def test_cancelled_id_broadcast_window_is_bounded(tmp_path):
+    state = SchedulerState(MemoryBackend())
+    state.save_job_status("j1", JobStatus("running"))
+    assert state.cancel_job("j1", "client")
+    assert state.cancelled_job_ids() == ["j1"]
+    # age the entry past the broadcast window: pruned
+    with state._lock:
+        state._cancelled_jobs["j1"] -= state.CANCEL_BROADCAST_SECS + 1
+    assert state.cancelled_job_ids() == []
+    # the terminal state is still visible (KV, not the broadcast set)
+    assert state.is_job_cancelled("j1")
+
+
+# ---------------------------------------------------------------------------
+# (c) server-side deadlines + slow-query kill (reap pass)
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expiry_cancels_job(tmp_path):
+    svc = SchedulerService(SchedulerState(MemoryBackend()))
+    job_id = _submit_groupby(svc, _source(tmp_path), deadline_secs=0.05)
+    assert svc.state.get_job_deadline(job_id) is not None
+    time.sleep(0.1)
+    reaped = svc.state.reap_expired_jobs(min_interval_secs=0.0)
+    assert job_id in reaped
+    st = svc.state.get_job_status(job_id)
+    assert st.state == "cancelled" and st.cancel_reason == "deadline"
+    # terminal transition cleared the deadline entry
+    assert svc.state.get_job_deadline(job_id) is None
+
+
+def test_deadline_enforced_with_no_executors(tmp_path):
+    """With every executor down there are no PollWork calls; the reap
+    pass must still fire off the waiting client's GetJobStatus polls so
+    the deadline holds."""
+    state = SchedulerState(MemoryBackend())
+    server, svc, port = serve_scheduler(state, "localhost", 0)
+    try:
+        job_id = _submit_groupby(svc, _source(tmp_path), deadline_secs=0.2)
+        from ballista_tpu.distributed.client import wait_for_job
+
+        with pytest.raises(QueryCancelled) as ei:
+            wait_for_job("localhost", port, job_id, timeout=10)
+        assert ei.value.reason == "deadline" and ei.value.job_id == job_id
+    finally:
+        server.stop(grace=None)
+
+
+def test_slow_query_kill_reaps_overdue_jobs(tmp_path, monkeypatch):
+    monkeypatch.setenv("BALLISTA_SLOW_QUERY_KILL_SECS", "0.05")
+    svc = SchedulerService(SchedulerState(MemoryBackend()))
+    job_id = _submit_groupby(svc, _source(tmp_path))
+    time.sleep(0.1)
+    reaped = svc.state.reap_expired_jobs(min_interval_secs=0.0)
+    assert job_id in reaped
+    st = svc.state.get_job_status(job_id)
+    assert st.state == "cancelled" and st.cancel_reason == "slow-query-kill"
+
+
+# ---------------------------------------------------------------------------
+# (d) executor: drain flushes pending reports; poll backoff
+# ---------------------------------------------------------------------------
+
+
+def test_drain_flushes_pending_status(tmp_path):
+    """A drained executor's last word: completion reports pending at
+    stop(drain=True) reach the scheduler in the final flush even though
+    the poll loop never runs again."""
+    state = SchedulerState(MemoryBackend())
+    server, svc, port = serve_scheduler(state, "localhost", 0)
+    ex = None
+    try:
+        state.save_job_status("j1", JobStatus("running"))
+        state.save_stage_plan("j1", 1, b"", 1, [])
+        ex = Executor(ExecutorConfig(work_dir=str(tmp_path / "w"),
+                                     scheduler_port=port))
+        pid = PartitionId("j1", 1, 0)
+        ex._report_completed(
+            pid, {"path": "/w/data.arrow", "num_rows": 3, "num_bytes": 64})
+        assert state.get_task_statuses("j1", 1) == []  # not delivered yet
+        ex.stop(drain=True, drain_timeout=0.05)
+        (st,) = state.get_task_statuses("j1", 1)
+        assert st.state == "completed" and st.path == "/w/data.arrow"
+    finally:
+        if ex is not None:
+            ex._pool.shutdown(wait=False)
+        server.stop(grace=None)
+
+
+def test_poll_backoff_no_log_storm(caplog):
+    """While the scheduler is down the poll loop backs off with jitter
+    and logs ONE traceback + one-line repeats — not a full traceback
+    every 250ms (thundering-herd / log-storm guard)."""
+    ex = Executor(ExecutorConfig(scheduler_port=1))  # nothing listens
+    logger = logging.getLogger("ballista.executor")
+    old_propagate = logger.propagate
+    logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING, logger="ballista.executor"):
+            ex.start()
+            time.sleep(1.3)
+            ex.stop()
+    finally:
+        logger.propagate = old_propagate
+    polls = [r for r in caplog.records
+             if "poll" in r.getMessage() or "backing off" in r.getMessage()]
+    with_tb = [r for r in polls if r.exc_info]
+    assert len(with_tb) == 1, \
+        f"expected ONE traceback, got {len(with_tb)} of {len(polls)}"
+    assert any("still failing" in r.getMessage() for r in polls)
+    # backoff actually spaced the retries: ~1.3s of downtime at 250ms
+    # fixed interval would be ~5 failures; backoff caps it lower
+    assert len(polls) <= 4
+
+
+# ---------------------------------------------------------------------------
+# (e) standalone path: ctx/df cancel + slow-query kill + system.queries
+# ---------------------------------------------------------------------------
+
+
+def test_standalone_cancel_from_another_thread():
+    ctx = _slow_ctx(delay_secs=0.25, parts=4)
+    df = ctx.sql("select a, c from t")
+    box = {}
+
+    def run():
+        try:
+            box["out"] = df.collect()
+        except BaseException as e:  # noqa: BLE001 - captured for asserts
+            box["err"] = e
+
+    th = threading.Thread(target=run)
+    th.start()
+    _wait_until(lambda: ctx._active_tokens, 5, "collect never registered")
+    assert ctx.cancel("client") == 1
+    th.join(20)
+    assert not th.is_alive(), "collect hung after cancel"
+    err = box.get("err")
+    assert isinstance(err, QueryCancelled) and err.reason == "client"
+
+    # terminal record lands in system.queries as cancelled + reason
+    rows = ctx.sql(
+        "select status, cancel_reason from system.queries").collect()
+    cancelled = rows[rows["status"] == "cancelled"]
+    assert len(cancelled) >= 1
+    assert "client" in set(cancelled["cancel_reason"])
+
+    # the context stays usable: the SAME query completes afterwards
+    out = ctx.sql("select sum(a) as s from t").collect()
+    assert int(out["s"][0]) == sum(range(64))
+
+
+def test_standalone_slow_query_kill(monkeypatch):
+    monkeypatch.setenv("BALLISTA_SLOW_QUERY_KILL_SECS", "0.1")
+    ctx = _slow_ctx(delay_secs=0.25, parts=4)
+    with pytest.raises(QueryCancelled) as ei:
+        ctx.sql("select a, c from t").collect()
+    assert ei.value.reason == "slow-query-kill"
+    monkeypatch.delenv("BALLISTA_SLOW_QUERY_KILL_SECS")
+    rows = ctx.sql(
+        "select status, cancel_reason from system.queries").collect()
+    assert "slow-query-kill" in set(
+        rows[rows["status"] == "cancelled"]["cancel_reason"])
+
+
+# ---------------------------------------------------------------------------
+# (f) e2e gates on a real LocalCluster
+# ---------------------------------------------------------------------------
+
+
+def test_cancel_mid_stage_e2e(tmp_path, faults_env):
+    """THE e2e gate: a job cancelled mid-stage reaches Cancelled in
+    system.queries, its executors' slots free within 5s, and a
+    follow-up job on the same cluster completes byte-identical."""
+    path = _write_tbl(tmp_path)
+    cluster = LocalCluster(num_executors=2, concurrent_tasks=2)
+    try:
+        ctx = _remote_ctx(cluster)
+        ctx.register_tbl("t", path, TSCHEMA)
+        # every task start sleeps 600ms: a deterministic mid-stage window
+        faults_env("executor.task.start=delay:600")
+        box = {}
+
+        def run():
+            try:
+                box["out"] = ctx.sql(GROUPBY_SQL).collect()
+            except BaseException as e:  # noqa: BLE001 - captured
+                box["err"] = e
+
+        th = threading.Thread(target=run)
+        th.start()
+        _wait_until(lambda: any(e._task_tokens for e in cluster.executors),
+                    10, "no task ever started")
+        assert ctx.cancel("client") >= 1
+        th.join(20)
+        assert not th.is_alive(), "collect hung after cancel"
+        err = box.get("err")
+        assert isinstance(err, QueryCancelled), f"got {box}"
+        job_id = err.job_id
+        assert job_id
+
+        st = cluster.state.get_job_status(job_id)
+        assert st.state == "cancelled" and st.cancel_reason == "client"
+
+        # executor slots free within 5s (tokens fired at the next poll,
+        # tasks aborted at their batch boundary)
+        _wait_until(
+            lambda: all(not e._task_tokens and e._inflight == 0
+                        for e in cluster.executors),
+            5, "executor slots not freed within 5s of cancel")
+
+        # system.queries (fetched from the scheduler) has the terminal
+        # cancelled record with its reason
+        rows = ctx.sql("select job_id, status, cancel_reason "
+                       "from system.queries").collect()
+        rec = rows[rows["job_id"] == job_id]
+        assert len(rec) == 1
+        assert rec["status"].iloc[0] == "cancelled"
+        assert rec["cancel_reason"].iloc[0] == "client"
+
+        # follow-up job on the SAME cluster: byte-identical
+        faults_env("")
+        _assert_identical(ctx.sql(GROUPBY_SQL).collect(), _expected())
+    finally:
+        faults_env("")
+        cluster.shutdown()
+
+
+def test_server_deadline_e2e(tmp_path, faults_env):
+    path = _write_tbl(tmp_path)
+    cluster = LocalCluster(num_executors=2, concurrent_tasks=2)
+    try:
+        ctx = _remote_ctx(cluster, **{"job.deadline": "0.5"})
+        ctx.register_tbl("t", path, TSCHEMA)
+        faults_env("executor.task.start=delay:700")
+        t0 = time.time()
+        with pytest.raises(QueryCancelled) as ei:
+            ctx.sql(GROUPBY_SQL).collect()
+        assert ei.value.reason == "deadline"
+        # terminated within the deadline plus reap/poll slack
+        assert time.time() - t0 < 15
+        st = cluster.state.get_job_status(ei.value.job_id)
+        assert st.state == "cancelled" and st.cancel_reason == "deadline"
+    finally:
+        faults_env("")
+        cluster.shutdown()
+
+
+def test_client_timeout_issues_best_effort_cancel(tmp_path, faults_env,
+                                                  monkeypatch):
+    path = _write_tbl(tmp_path)
+    cluster = LocalCluster(num_executors=2, concurrent_tasks=2)
+    try:
+        ctx = _remote_ctx(cluster, **{"job.timeout": "0.8"})
+        ctx.register_tbl("t", path, TSCHEMA)
+        faults_env("executor.task.start=delay:700")
+        with pytest.raises(ClusterError) as ei:
+            ctx.sql(GROUPBY_SQL).collect()
+        # the error carries the job id for system.queries triage
+        job_id = ei.value.job_id
+        assert job_id
+        # ... and the scheduler moves the abandoned job to cancelled
+        _wait_until(
+            lambda: cluster.state.get_job_status(job_id).state
+            == "cancelled",
+            5, "timed-out job was never cancelled")
+        assert cluster.state.get_job_status(job_id).cancel_reason \
+            == "timeout"
+
+        # knob off: the old abandon-the-job behavior (job keeps running)
+        monkeypatch.setenv("BALLISTA_CANCEL_ON_TIMEOUT", "0")
+        with pytest.raises(ClusterError) as ei2:
+            ctx.sql("select c, sum(a) as s2 from t group by c").collect()
+        job2 = ei2.value.job_id
+        st = cluster.state.get_job_status(job2)
+        assert st.state in ("queued", "running")
+        # clean up so shutdown doesn't wait on it
+        monkeypatch.delenv("BALLISTA_CANCEL_ON_TIMEOUT")
+        cluster.service.CancelJob(pb.CancelJobParams(job_id=job2))
+    finally:
+        faults_env("")
+        cluster.shutdown()
+
+
+def test_graceful_drain_migrates_inflight_task(tmp_path, faults_env):
+    """stop(drain=True): the draining executor stops accepting, cancels
+    its in-flight task at the bound, its reports are flushed, and the
+    job COMPLETES on the remaining executor (drain-cancelled attempts
+    are transient-shaped, so the scheduler re-queues them)."""
+    path = _write_tbl(tmp_path)
+    cluster = LocalCluster(num_executors=2, concurrent_tasks=1)
+    try:
+        ctx = _remote_ctx(cluster)
+        ctx.register_tbl("t", path, TSCHEMA)
+        faults_env("executor.task.start=delay:800")
+        box = {}
+
+        def run():
+            try:
+                box["out"] = ctx.sql(GROUPBY_SQL).collect()
+            except BaseException as e:  # noqa: BLE001 - captured
+                box["err"] = e
+
+        th = threading.Thread(target=run)
+        th.start()
+        drained = cluster.executors[0]
+        _wait_until(lambda: drained._task_tokens, 10,
+                    "executor 0 never picked up a task")
+        drained.stop(drain=True, drain_timeout=0.05)
+        assert drained.tasks_cancelled >= 1
+        th.join(45)
+        assert not th.is_alive(), "job hung after drain"
+        assert "err" not in box, f"job failed after drain: {box.get('err')}"
+        _assert_identical(box["out"], _expected())
+    finally:
+        faults_env("")
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# (g) recovery-on-faults: the hand-rolled shuffle loss, now injected
+# ---------------------------------------------------------------------------
+
+
+def test_shuffle_fetch_fault_rides_retry_and_recovery(tmp_path, faults_env,
+                                                      monkeypatch):
+    """Port of test_recovery's hand-crafted shuffle-loss setup onto the
+    fault layer: an injected fetch failure takes the SAME tagged
+    ShuffleFetchError path (in-task retry first, producer re-queue
+    beyond it) — no work_dir deletion or fake statuses needed."""
+    monkeypatch.setattr(ShuffleReaderExec, "FORCE_REMOTE", True)
+    path = _write_tbl(tmp_path)
+    cluster = LocalCluster(num_executors=2, concurrent_tasks=2)
+    try:
+        ctx = _remote_ctx(cluster)
+        ctx.register_tbl("t", path, TSCHEMA)
+        faults_env("shuffle.fetch=fail-once")
+        _assert_identical(ctx.sql(GROUPBY_SQL).collect(), _expected())
+        # the armed rule genuinely fired (vacuous pass guard)
+        assert faults_mod._rules["shuffle.fetch"].hits >= 1
+    finally:
+        faults_env("")
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# (h) chaos sweep: deterministic fault configs on a LocalCluster
+# ---------------------------------------------------------------------------
+
+# seed -> (BALLISTA_FAULTS spec, extra ctx settings, env overrides).
+# Outcome law (the chaos gate): every job either completes
+# byte-identical or terminates cleanly (Failed/Cancelled) within its
+# deadline — zero hangs, retry budgets respected. "must_complete" seeds
+# additionally REQUIRE the identical completion (the injected fault is
+# within the engine's recovery envelope).
+CHAOS_SEEDS = {
+    "baseline": ("", {}, {}, True),
+    "task-fail-once": ("executor.task.start=fail-once", {}, {}, True),
+    "task-fail-every-3": ("executor.task.start=fail-every:3", {}, {},
+                          False),
+    "shuffle-fail-once": ("shuffle.fetch=fail-once:2", {}, {}, True),
+    "shuffle-fail-always": ("shuffle.fetch=fail-every:1", {}, {}, False),
+    "poll-fail-once": ("scheduler.poll_work=fail-once:3", {}, {}, True),
+    "state-save-fail": ("state.save=fail-once:4", {}, {}, False),
+    "rpc-delay": ("client.rpc=delay:25", {}, {}, True),
+    "task-delay-deadline": ("executor.task.start=delay:400",
+                            {"job.deadline": "1.0"}, {}, False),
+    "dataplane-drop": ("dataplane.serve=drop-once", {},
+                       {"BALLISTA_NATIVE_DATAPLANE": "off"}, False),
+}
+
+
+@pytest.mark.parametrize("seed", sorted(CHAOS_SEEDS))
+def test_chaos_sweep(tmp_path, faults_env, monkeypatch, seed):
+    spec, extra_settings, env, must_complete = CHAOS_SEEDS[seed]
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    # shuffle reads must cross the data plane for fetch/serve faults
+    monkeypatch.setattr(ShuffleReaderExec, "FORCE_REMOTE", True)
+    path = _write_tbl(tmp_path)
+    cluster = LocalCluster(num_executors=2, concurrent_tasks=2)
+    try:
+        ctx = _remote_ctx(cluster, **{"job.timeout": "45",
+                                      **extra_settings})
+        ctx.register_tbl("t", path, TSCHEMA)
+        faults_env(spec)
+        box = {}
+
+        def run():
+            try:
+                box["out"] = ctx.sql(GROUPBY_SQL).collect()
+            except BaseException as e:  # noqa: BLE001 - captured
+                box["err"] = e
+
+        t0 = time.time()
+        th = threading.Thread(target=run)
+        th.start()
+        th.join(60)
+        elapsed = time.time() - t0
+        assert not th.is_alive(), f"seed {seed}: HUNG after {elapsed:.0f}s"
+
+        if "out" in box:
+            _assert_identical(box["out"], _expected())
+        else:
+            err = box["err"]
+            assert isinstance(err, (ClusterError, QueryCancelled)), \
+                f"seed {seed}: dirty failure {type(err).__name__}: {err}"
+            assert not must_complete, \
+                f"seed {seed}: expected completion, got {err}"
+            if isinstance(err, QueryCancelled):
+                # a deadline kill must land near its deadline, not at
+                # the client timeout
+                assert elapsed < 20
+            # retry budgets respected: never more than budget+1 attempts
+            jid = getattr(err, "job_id", None)
+            if jid:
+                assert cluster.state._recovery_count(jid) <= \
+                    cluster.state.MAX_RECOVERIES_PER_JOB + 1
+    finally:
+        faults_env("")
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# (i) overhead gate: disabled fault points + cancel-token machinery < 5%
+# ---------------------------------------------------------------------------
+
+
+def test_lifecycle_overhead_q1_under_5pct(tmp_path_factory, faults_env):
+    """Drift-cancelling overhead gate (same method as the metrics gate
+    in test_observability): warm q1 through the full lifecycle wrapper
+    (token + bind + killer no-op + tracked registration) with an
+    armed-but-idle fault spec, vs the bare governed collect with faults
+    disarmed. Interleaved alternating samples + medians cancel machine
+    drift; <5% (+2ms floor) or fail."""
+    from benchmarks.tpch import datagen
+    from benchmarks.tpch.schema_def import register_tpch
+
+    data_dir = str(tmp_path_factory.mktemp("tpch_lc"))
+    datagen.generate(data_dir, scale=0.01, num_parts=1)
+    ctx = BallistaContext.standalone()
+    register_tpch(ctx, data_dir, "tbl")
+    qdir = os.path.join(REPO, "benchmarks", "tpch", "queries")
+    df = ctx.sql(open(os.path.join(qdir, "q1.sql")).read())
+    df.collect()  # warm: jit compile + table caches
+    plan, phys = df.plan, df._phys
+
+    # a rule that can never fire: hit ceiling far beyond the run count
+    IDLE_SPEC = "executor.task.start=fail-once:1000000000"
+
+    def sample(on: bool) -> float:
+        faults_env(IDLE_SPEC if on else "")
+        t0 = time.perf_counter()
+        for _ in range(3):
+            if on:
+                ctx._standalone_collect_inner(plan, phys)
+            else:
+                ctx._standalone_collect_governed(plan, phys)
+        return time.perf_counter() - t0
+
+    sample(True)
+    sample(False)  # settle both paths before measuring
+
+    def measure():
+        offs, ons = [], []
+        for i in range(9):
+            if i % 2 == 0:
+                offs.append(sample(False))
+                ons.append(sample(True))
+            else:
+                ons.append(sample(True))
+                offs.append(sample(False))
+        return sorted(offs)[4], sorted(ons)[4]
+
+    for _ in range(3):
+        t_off, t_on = measure()
+        if t_on <= t_off * 1.05 + 2e-3:
+            return
+    overhead = (t_on - t_off) / t_off
+    raise AssertionError(
+        f"lifecycle overhead {overhead:.1%} "
+        f"(on={t_on:.4f}s off={t_off:.4f}s)")
