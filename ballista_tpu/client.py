@@ -56,6 +56,15 @@ class BallistaContext:
         self.host = host
         self.port = port
         self.settings = dict(settings or {})
+        # per-session resource metering (observability/progress.py):
+        # every query this context runs is accounted to one session id.
+        # It travels with the submitted settings so the scheduler's
+        # terminal hook can meter cluster jobs; a caller-supplied
+        # "session.id" setting wins (shared-session pools).
+        import uuid
+
+        self.session_id = self.settings.setdefault(
+            "session.id", uuid.uuid4().hex[:12])
         self._catalog: Dict[str, CatalogTable] = {}
         # SQL plan cache: repeated identical queries reuse the planned (and,
         # in standalone mode, compiled) DataFrame; invalidated on any
@@ -295,9 +304,10 @@ class BallistaContext:
                         "CancelJob(%s) failed", jid, exc_info=True)
         return n
 
-    def _collect(self, plan: LogicalPlan):
+    def _collect(self, plan: LogicalPlan, on_progress=None):
         if self.mode == "standalone":
-            out, _ = self._standalone_collect(plan)
+            out, _ = self._standalone_collect(plan,
+                                              on_progress=on_progress)
             return out
         from .distributed.client import remote_collect
 
@@ -307,13 +317,46 @@ class BallistaContext:
         # ctx.cancel() can CancelJob the job while this thread waits
         with self._track_lifecycle(jsink, self._active_job_sinks):
             out = remote_collect(self.host, self.port, plan, self.settings,
-                                 metrics_out=sink, job_id_out=jsink)
+                                 metrics_out=sink, job_id_out=jsink,
+                                 on_progress=on_progress)
         self._last_query_metrics = sink[0] if sink else None
         self._last_query_phys = None
         self._last_job_id = jsink[0] if jsink else None
         return out
 
-    def _standalone_collect(self, plan: LogicalPlan, phys=None):
+    def job_progress(self, job_id: Optional[str] = None):
+        """Live progress snapshot of a job (the ONE progress shape —
+        see docs/observability.md): per-stage completion fractions,
+        rate-based ETA, task counts. ``job_id`` defaults to this
+        context's most recent remote job. Remote contexts ask the
+        scheduler (extended GetJobStatus); standalone contexts report
+        their in-flight collects. Returns None when nothing is known
+        about the job."""
+        if self.mode == "remote":
+            jid = job_id
+            if not jid:
+                # prefer a currently in-flight job (another thread's
+                # collect registered its id at SUBMIT time — the same
+                # channel ctx.cancel() uses) over the last finished one
+                with self._lifecycle_lock:
+                    inflight = [j for sink in self._active_job_sinks
+                                for j in list(sink)]
+                jid = (inflight[-1] if inflight else None) \
+                    or self._last_job_id
+            if not jid:
+                return None
+            from .distributed.client import fetch_job_progress
+
+            return fetch_job_progress(self.host, self.port, jid)
+        from .observability import progress as obs_progress
+
+        handles = obs_progress.local_live_handles()
+        if job_id is not None:
+            handles = [h for h in handles if h.job_id == job_id]
+        return handles[-1].snapshot() if handles else None
+
+    def _standalone_collect(self, plan: LogicalPlan, phys=None,
+                            on_progress=None):
         """Shared standalone execute-and-wrap: plan (unless the caller
         passes a cached physical plan), execute, record metrics.
         Returns ``(frame, phys)`` so DataFrame.collect can keep its
@@ -323,15 +366,32 @@ class BallistaContext:
         rows, flight-recorder lanes, artifact path) lands in the shared
         system-tables snapshot + the durable query-history log
         (observability/systables.py) — the standalone face of the
-        scheduler's terminal-transition hook."""
+        scheduler's terminal-transition hook. ``on_progress`` (live
+        progress plane) receives snapshots of the ONE progress shape
+        from a sampler thread over the executing plan's MetricsSet —
+        parity with the cluster path's GetJobStatus-driven callbacks."""
         from .observability.systables import StandaloneQueryRecorder
 
-        rec = StandaloneQueryRecorder(plan)
+        rec = StandaloneQueryRecorder(plan, session_id=self.session_id)
+        sampler = None
+        if on_progress is not None:
+            from .observability.progress import LocalProgressSampler
+
+            sampler = LocalProgressSampler(rec.handle, on_progress)
         try:
             out, phys2 = self._standalone_collect_routed(plan, phys, rec)
         except Exception as e:  # noqa: BLE001 - record, then propagate
+            from .errors import QueryCancelled
+
+            if sampler is not None:
+                sampler.finish("cancelled" if isinstance(e, QueryCancelled)
+                               else "failed")
             rec.finish("failed", error=e)
             raise
+        if sampler is not None:
+            # terminal callback BEFORE the recorder tears the handle
+            # down: the final snapshot reports fraction exactly 1.0
+            sampler.finish("completed")
         rec.finish("completed", result=out, phys=phys2)
         return out, phys2
 
@@ -449,6 +509,15 @@ class BallistaContext:
         prime_plan(phys)
         try:
             phys = self._apply_adaptive(phys)
+            # live progress plane: expose the FINAL (post-adaptive)
+            # tree to this thread's active query handle — the
+            # on_progress sampler and system.tasks/system.stages read
+            # it weakly (no-op for unrecorded inner collects: EXPLAIN,
+            # df.profile()). After the adaptive pass so the weak ref
+            # survives: a rewritten root replaces the planned one.
+            from .observability import progress as obs_progress
+
+            obs_progress.attach_current_plan(phys)
             out = pd.DataFrame(collect_physical(phys))
         finally:
             cancel_plan(phys)
@@ -628,8 +697,18 @@ class DataFrame:
 
     # -- execution ----------------------------------------------------------
 
-    def collect(self):
-        """Execute and return a pandas DataFrame."""
+    def collect(self, on_progress=None):
+        """Execute and return a pandas DataFrame.
+
+        ``on_progress`` (live progress plane): a callable receiving
+        progress snapshots — the ONE shape both paths share (job_id,
+        fraction, eta_seconds, task counts, per-stage rows; see
+        docs/observability.md). On the cluster path snapshots come from
+        the scheduler's live job model via the status poll; standalone,
+        a sampler thread over the executing plan's MetricsSet reports
+        the same shape. Callbacks run on a background/polling thread
+        and are best-effort: a raising callback is logged, never the
+        query's problem. The final callback reports fraction 1.0."""
         if self._raw_sql is not None:
             from .distributed.client import remote_sql_collect
 
@@ -640,7 +719,7 @@ class DataFrame:
                 out = remote_sql_collect(
                     self.ctx.host, self.ctx.port, self._raw_sql,
                     self.ctx._catalog, self.ctx.settings, metrics_out=sink,
-                    job_id_out=jsink,
+                    job_id_out=jsink, on_progress=on_progress,
                 )
             self.ctx._last_query_metrics = sink[0] if sink else None
             self.ctx._last_query_phys = None
@@ -648,9 +727,9 @@ class DataFrame:
             return out
         if self.ctx.mode == "standalone":
             out, self._phys = self.ctx._standalone_collect(
-                self.plan, phys=self._phys)
+                self.plan, phys=self._phys, on_progress=on_progress)
             return out
-        return self.ctx._collect(self.plan)
+        return self.ctx._collect(self.plan, on_progress=on_progress)
 
     def to_pandas(self):
         return self.collect()

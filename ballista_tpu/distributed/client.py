@@ -138,17 +138,51 @@ def submit_sql(host: str, port: int, sql: str, catalog,
         client.close()
 
 
+def _emit_progress(result, job_id: str, on_progress, last: list,
+                   status: str = "running") -> None:
+    """Invoke the caller's progress callback from the status poll when
+    the scheduler's snapshot changed. Best-effort: a raising callback
+    is logged, never the query's problem."""
+    if on_progress is None or not result.HasField("progress"):
+        return
+    from .. import serde as _serde
+
+    snap = _serde.job_progress_from_proto(result.progress, job_id,
+                                          status=status)
+    from ..observability.progress import emit_if_changed, force_completed
+
+    if status == "completed":
+        # the client can observe the terminal KV before the tracker's
+        # final snapshot freezes (the hook runs after the status save):
+        # the terminal callback must still report exactly 1.0 — job
+        # AND stage rows
+        force_completed(snap)
+
+    last[:] = [emit_if_changed(on_progress, snap,
+                               last[-1] if last else None)]
+
+
 def wait_for_job(host: str, port: int, job_id: str,
-                 timeout: float = 300.0) -> pb.GetJobStatusResult:
+                 timeout: float = 300.0,
+                 on_progress=None) -> pb.GetJobStatusResult:
     client = SchedulerClient(host, port)
+    last: list = []
     try:
         deadline = time.time() + timeout
         while True:
             result = client.GetJobStatus(pb.GetJobStatusParams(job_id=job_id))
             which = result.status.WhichOneof("status")
             if which == "completed":
+                # terminal callback: the tracker's frozen final
+                # snapshot reports fraction exactly 1.0
+                _emit_progress(result, job_id, on_progress, last,
+                               status="completed")
                 return result
             if which == "failed":
+                # terminal callback carries the terminal status — a
+                # progress UI must not show "running" as the job dies
+                _emit_progress(result, job_id, on_progress, last,
+                               status="failed")
                 raise ClusterError(
                     f"job {job_id} failed: {result.status.failed.error}",
                     job_id=job_id,
@@ -157,10 +191,18 @@ def wait_for_job(host: str, port: int, job_id: str,
                 # terminal Cancelled (client CancelJob, server deadline,
                 # slow-query kill, drain): distinct from failure so
                 # callers can tell "stopped on purpose" from "broke"
+                _emit_progress(result, job_id, on_progress, last,
+                               status="cancelled")
                 raise QueryCancelled(
                     result.status.cancelled.reason or "unknown",
                     job_id=job_id,
                 )
+            # non-terminal: the snapshot's status mirrors the oneof
+            # (queued jobs must not read "running" — ONE shape with
+            # fetch_job_progress)
+            _emit_progress(result, job_id, on_progress, last,
+                           status="queued" if which == "queued"
+                           else "running")
             if time.time() > deadline:
                 if _cancel_on_timeout_enabled():
                     # best-effort: an abandoned client must not leak a
@@ -202,12 +244,15 @@ def remote_collect(host: str, port: int, logical_plan,
                    settings: Optional[Dict[str, str]] = None,
                    timeout: Optional[float] = None,
                    metrics_out: Optional[list] = None,
-                   job_id_out: Optional[list] = None):
+                   job_id_out: Optional[list] = None,
+                   on_progress=None):
     """Submit + poll + fetch -> pandas DataFrame. ``metrics_out``
     (when a list) receives the job's per-stage QueryMetrics, which ride
     the completed JobStatus (ctx.last_query_metrics()); ``job_id_out``
     receives the scheduler-assigned job id (the handle the distributed
-    profiler's GetJobProfile / /debug/profile/<job_id> take)."""
+    profiler's GetJobProfile / /debug/profile/<job_id> take);
+    ``on_progress`` receives live progress snapshots off the status
+    poll (the ONE shape — see observability/progress.py)."""
     from ..execution import resolve_scalar_subqueries
 
     deadline = _job_timeout(settings, timeout)  # fail fast pre-submit
@@ -215,7 +260,8 @@ def remote_collect(host: str, port: int, logical_plan,
     job_id = submit_plan(host, port, logical_plan, settings)
     if job_id_out is not None:
         job_id_out.append(job_id)
-    result = wait_for_job(host, port, job_id, deadline)
+    result = wait_for_job(host, port, job_id, deadline,
+                          on_progress=on_progress)
     _deliver_metrics(result, metrics_out)
     return _fetch_result_frames(result)
 
@@ -224,15 +270,48 @@ def remote_sql_collect(host: str, port: int, sql: str, catalog,
                        settings: Optional[Dict[str, str]] = None,
                        timeout: Optional[float] = None,
                        metrics_out: Optional[list] = None,
-                       job_id_out: Optional[list] = None):
+                       job_id_out: Optional[list] = None,
+                       on_progress=None):
     """Raw-SQL round trip: submit SQL + catalog, poll, fetch."""
     deadline = _job_timeout(settings, timeout)  # fail fast pre-submit
     job_id = submit_sql(host, port, sql, catalog, settings)
     if job_id_out is not None:
         job_id_out.append(job_id)
-    result = wait_for_job(host, port, job_id, deadline)
+    result = wait_for_job(host, port, job_id, deadline,
+                          on_progress=on_progress)
     _deliver_metrics(result, metrics_out)
     return _fetch_result_frames(result)
+
+
+def fetch_job_progress(host: str, port: int, job_id: str
+                       ) -> Optional[dict]:
+    """One live progress snapshot for a job (ctx.job_progress()):
+    the extended GetJobStatus's progress field, or None when the
+    scheduler's tracker doesn't know the job."""
+    client = SchedulerClient(host, port)
+    try:
+        result = client.GetJobStatus(pb.GetJobStatusParams(job_id=job_id))
+    finally:
+        client.close()
+    if not result.HasField("progress"):
+        return None
+    from .. import serde as _serde
+
+    which = result.status.WhichOneof("status")
+    status = {"queued": "queued", "running": "running",
+              "completed": "completed", "failed": "failed",
+              "cancelled": "cancelled"}.get(which, "unknown")
+    snap = _serde.job_progress_from_proto(result.progress, job_id,
+                                          status=status)
+    if status == "completed":
+        # same race as _emit_progress: the completed KV can be visible
+        # before the tracker's finish() freezes (or while its TTL cache
+        # holds a pre-terminal snapshot) — a completed job must never
+        # read below 1.0
+        from ..observability.progress import force_completed
+
+        force_completed(snap)
+    return snap
 
 
 def fetch_job_profile(host: str, port: int, job_id: str,

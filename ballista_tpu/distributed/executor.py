@@ -146,6 +146,13 @@ class Executor:
         self._task_tokens: Dict[str, CancelToken] = {}  # task key -> token
         self._draining = False
         self._cleaned_jobs: deque = deque(maxlen=256)
+        # live progress plane: the executing plan of every in-flight
+        # task, sampled on the progress cadence and piggybacked on
+        # PollWork as TaskProgress records (best-effort; see
+        # observability/progress.py)
+        self._progress_lock = threading.Lock()
+        self._running_plans: Dict[str, dict] = {}  # task key -> entry
+        self._last_progress_sample = 0.0
         # health plane: task counters (benign-race ints under the GIL,
         # same policy as observability.metrics), a ring of recent task
         # summaries, and — when enabled — /healthz + /metrics +
@@ -368,6 +375,11 @@ class Executor:
                 else:
                     budget -= sz
             params.task_status.append(st)
+        # live progress piggyback: advisory payload, never re-delivered
+        # on a failed poll (unlike the reports above — the next sample
+        # supersedes a lost one anyway)
+        for tp in self._maybe_sample_progress():
+            params.task_progress.append(tp)
         try:
             result = self._client.PollWork(params)
         except Exception:
@@ -384,6 +396,59 @@ class Executor:
             self._handle_job_cancelled(job_id)
         if result.HasField("task"):
             self._run_task(result.task)
+
+    def _maybe_sample_progress(self):
+        """TaskProgress records for this poll, or [] (plane disabled,
+        cadence not due, nothing running, or a triggered
+        ``scheduler.progress_report`` fault). Samples never force a
+        device sync (snapshot_rows resolves only ready scalars) and any
+        failure here degrades to an unsampled poll — progress is
+        advisory by contract."""
+        from ..observability import progress as obs_progress
+
+        interval = obs_progress.progress_interval_secs()
+        if interval is None:
+            return []
+        now = time.time()
+        if now - self._last_progress_sample < interval:
+            return []
+        self._last_progress_sample = now
+        with self._progress_lock:
+            entries = list(self._running_plans.values())
+        if not entries:
+            return []
+        out = []
+        try:
+            # chaos surface: "drop" skips this round's piggyback,
+            # "delay" stalls it, a "fail" raise is swallowed below —
+            # results must be byte-identical under any of them
+            if fault_point("scheduler.progress_report",
+                           executor=self.id[:8]) == "drop":
+                return []
+            for entry in entries:
+                if entry.get("input_total") is None:
+                    # this task executes ONE partition of the shared
+                    # stage plan: estimate its per-partition share
+                    entry["input_total"] = obs_progress.plan_input_estimate(
+                        entry["plan"], per_partition=True)
+                s = obs_progress.sample_plan(
+                    entry["plan"], input_rows_total=entry["input_total"])
+                pid = entry["pid"]
+                tp = pb.TaskProgress()
+                tp.partition_id.job_id = pid.job_id
+                tp.partition_id.stage_id = pid.stage_id
+                tp.partition_id.partition_id = pid.partition_id
+                tp.stage_version = entry["stage_version"]
+                tp.operator = s["operator"] or ""
+                tp.rows_so_far = max(int(s["rows_so_far"]), 0)
+                tp.input_rows_total = max(int(s["input_rows_total"]), 0)
+                tp.bytes_so_far = max(int(s["bytes_so_far"]), 0)
+                tp.elapsed_seconds = now - entry["t0"]
+                out.append(tp)
+        except Exception:  # noqa: BLE001 - best-effort by contract
+            log.debug("progress sample failed", exc_info=True)
+            return []
+        return out
 
     def _handle_job_cancelled(self, job_id: str):
         """A PollWorkResult carried this job id as cancelled: abort its
@@ -452,6 +517,14 @@ class Executor:
 
             t0 = time.time()
             self._inflight += 1
+            # live progress: expose the executing plan to the poll
+            # thread's sampler for the duration of the task
+            with self._progress_lock:
+                self._running_plans[pid.key()] = {
+                    "pid": pid, "plan": plan, "t0": t0,
+                    "stage_version": td.stage_version,
+                    "input_total": None,
+                }
             # per-task profile window (distributed profiler): snapshot
             # the process-wide ingest/compile accumulators up front so
             # the completion payload can ship deltas alongside the
@@ -552,6 +625,8 @@ class Executor:
             finally:
                 with self._token_lock:
                     self._task_tokens.pop(pid.key(), None)
+                with self._progress_lock:
+                    self._running_plans.pop(pid.key(), None)
                 self._inflight -= 1
                 self._slots.release()
 

@@ -265,6 +265,13 @@ class SchedulerService:
 
         set_process_identity("scheduler")
         self.profiles = JobProfileCollector()
+        # live progress plane (observability/progress.py): executor
+        # TaskProgress piggybacks fold into per-stage completion
+        # fractions + ETAs, served through GetJobStatus, /debug/jobs,
+        # Prometheus gauges and the system.tasks/system.stages tables
+        from ..observability.progress import JobProgressTracker
+
+        self.progress = JobProgressTracker(state=state)
         # merge/render/write of terminal-job artifacts runs here, OFF
         # the RPC handler threads (thread created lazily on first use:
         # unprofiled schedulers never spawn it)
@@ -287,6 +294,8 @@ class SchedulerService:
         self.systables = SystemSnapshot(
             query_log=state.query_log, operators=self.system_ops,
             executors_fn=self._executor_rows,
+            tasks_fn=self.progress.task_rows,
+            stages_fn=self.progress.stage_rows,
         )
         self.tasks_dispatched = 0
         if metrics_port is None:
@@ -295,6 +304,7 @@ class SchedulerService:
             "scheduler", metrics_port, samples_fn=self._metric_samples,
             query_log=state.query_log,
             profile_fn=self._profile_artifact,
+            jobs_fn=self._debug_jobs,
         )
 
     def _metric_samples(self):
@@ -310,6 +320,18 @@ class SchedulerService:
             ("ballista_ready_queue_depth", {}, st.ready_queue_depth()),
             ("ballista_slow_queries_total", {}, st.query_log.slow_total),
         ]
+        # live progress gauges: per-job completion fraction + the
+        # cluster-wide running-task count (gated through the registry
+        # like every family; live jobs are bounded by the tracker cap)
+        try:
+            live = self.progress.live_snapshots()
+        except Exception:  # noqa: BLE001 - diagnosis plane
+            live = []
+        out.append(("ballista_tasks_running", {},
+                    sum(s["tasks_running"] for s in live)))
+        for s in live:
+            out.append(("ballista_job_progress_fraction",
+                        {"job": s["job_id"]}, s["fraction"]))
         for m in metas:
             # getattr: a durable backend may still hold ExecutorMeta
             # pickles written by pre-resources code (unpickling skips
@@ -331,10 +353,22 @@ class SchedulerService:
 
     def _executor_rows(self):
         """system.executors rows from the executor heartbeat metadata
-        (same source as the /metrics per-executor gauges)."""
+        (same source as the /metrics per-executor gauges). Built from
+        the DURABLE address records so a dead executor stays visible
+        from SQL: ``heartbeat_age_seconds`` is the scheduler-side clock
+        minus the last PollWork, and rows past
+        ``BALLISTA_EXECUTOR_STALE_SECS`` (or with no heartbeat this
+        scheduler lifetime) carry ``stale=true``."""
+        from ..observability.progress import executor_stale_secs
+
+        beats = self.state.executor_heartbeats()
+        thr = executor_stale_secs()
+        now = time.time()
         rows = []
-        for m in self.state.get_executors_metadata():
+        for m in self.state.all_executor_metadata():
             res = getattr(m, "resources", None) or {}
+            hb = beats.get(m.id)
+            age = (now - hb) if hb is not None else None
             rows.append({
                 "executor_id": m.id,
                 "host": m.host,
@@ -345,8 +379,18 @@ class SchedulerService:
                 "inflight_tasks": res.get("inflight_tasks"),
                 "ingest_pool_depth": res.get("ingest_pool_depth"),
                 "peak_host_bytes": res.get("peak_host_bytes"),
+                "heartbeat_age_seconds": round(age, 3)
+                if age is not None else None,
+                "stale": int(age is None or age > thr),
             })
         return rows
+
+    def _debug_jobs(self, job_id: "str | None"):
+        """``/debug/jobs`` (job_id None: every live job) and
+        ``/debug/jobs/<job_id>`` (live or recently terminal)."""
+        if job_id:
+            return self.progress.snapshot(job_id)
+        return self.progress.live_snapshots()
 
     def close_health(self):
         if self.health is not None:
@@ -373,6 +417,27 @@ class SchedulerService:
         from ..observability.registry import observe_histogram
 
         self.profiles.finalize(job_id, summary)
+        # live progress: freeze the final snapshot (fraction exactly
+        # 1.0 for completed jobs) and drop the job's sample store
+        try:
+            self.progress.finish(job_id, status.state)
+        except Exception:  # noqa: BLE001 - observability only
+            log.exception("progress finish failed for job %s", job_id)
+        # per-session metering: fold this job into its session's
+        # cumulative record (system.sessions); the session id traveled
+        # with the query settings. Only the id lookup happens here —
+        # SessionMeter.record rewrites its durable file, and file I/O
+        # does not belong on the PollWork handler thread, so the fold
+        # runs first on the background worker below (before annotate,
+        # which needs the record to exist)
+        session_id = ""
+        try:
+            from ..observability.progress import SESSION_SETTING
+
+            session_id = self.state.get_job_settings(job_id).get(
+                SESSION_SETTING, "")
+        except Exception:  # noqa: BLE001 - observability only
+            log.exception("session lookup failed for job %s", job_id)
         sm = getattr(status, "stage_metrics", None) or {}
         for sid, stage in sm.items():
             observe_histogram("ballista_stage_seconds",
@@ -402,6 +467,11 @@ class SchedulerService:
             # the PollWork handler thread — the merge walks every
             # collected task window.
             try:
+                self._meter_session(session_id, summary, status)
+            except Exception:  # noqa: BLE001 - observability only
+                log.exception("session metering failed for job %s",
+                              job_id)
+            try:
                 art = path = None
                 if want_artifact:
                     art = self.profiles.build(job_id, wall_seconds=wall,
@@ -419,6 +489,15 @@ class SchedulerService:
                 for lane, secs in lanes.items():
                     observe_histogram("ballista_query_lane_seconds",
                                       {"lane": lane}, float(secs))
+                # session metering, late fact: device-blocked seconds
+                # only exist once the lane decomposition lands here
+                if lanes.get("device_blocked"):
+                    from ..observability.progress import \
+                        process_session_meter
+
+                    process_session_meter().annotate(
+                        session_id,
+                        device_blocked_seconds=lanes["device_blocked"])
                 if art is not None:
                     from ..observability.export import write_artifact_file
 
@@ -450,6 +529,39 @@ class SchedulerService:
 
         self._profile_pool.submit(build_and_write)
 
+    def _meter_session(self, session_id: str, summary: dict,
+                       status) -> None:
+        """Fold one terminal job into its session's cumulative record:
+        wall seconds always; task seconds and shuffle bytes from the
+        completed-task stage metrics. ``bytes_shuffled`` counts the
+        write-side bytes every NON-FINAL stage materialized into the
+        data plane (ShuffleWrite rows for hash exchanges, PartitionWrite
+        rows for merge-type exchanges) — the observed wire bytes, the
+        honest metering unit for a shuffle data plane."""
+        from ..observability.progress import process_session_meter
+
+        sm = getattr(status, "stage_metrics", None) or {}
+        task_seconds = sum(float(st.get("elapsed_total", 0.0))
+                           for st in sm.values())
+        bytes_shuffled = 0
+        final_sid = max(sm) if sm else None
+        for sid, st in sm.items():
+            if sid == final_sid:
+                continue  # the result stage's write is not shuffle
+            for op in st.get("operators") or []:
+                if op.get("operator") in ("ShuffleWrite",
+                                          "PartitionWrite"):
+                    bytes_shuffled += int(
+                        (op.get("metrics") or {}).get("bytes_written", 0))
+        process_session_meter().record(
+            session_id,
+            wall_seconds=float(summary.get("wall_seconds", 0.0)),
+            task_seconds=task_seconds,
+            bytes_shuffled=bytes_shuffled,
+            peak_host_bytes=summary.get("peak_host_bytes") or 0,
+            peak_device_bytes=summary.get("peak_device_bytes") or 0,
+        )
+
     def _profile_artifact(self, job_id: str):
         """/debug/profile/<job_id>: the job's merged artifact (built on
         demand from the collector + flight recorder)."""
@@ -477,6 +589,9 @@ class SchedulerService:
             args = (job_id, None, settings, request.sql,
                     list(request.catalog))
         self.state.save_job_status(job_id, JobStatus("queued"))
+        # live progress: track from submission so /debug/jobs answers
+        # during planning too (fraction 0, no stages yet)
+        self.progress.register_job(job_id)
         t = threading.Thread(
             target=self._plan_job, args=args, daemon=True,
             name=f"plan-{job_id}",
@@ -609,6 +724,26 @@ class SchedulerService:
             resources=res,
         )
         self.state.save_executor_metadata(meta)
+        # live progress plane: fold the heartbeat's piggybacked task
+        # samples into the tracker. Advisory by contract — any failure
+        # here must not touch the scheduling work below.
+        if request.task_progress:
+            try:
+                for tp in request.task_progress:
+                    self.progress.record_report(
+                        tp.partition_id.job_id,
+                        tp.partition_id.stage_id,
+                        tp.partition_id.partition_id,
+                        {
+                            "rows_so_far": int(tp.rows_so_far),
+                            "input_rows_total": int(tp.input_rows_total),
+                            "bytes_so_far": int(tp.bytes_so_far),
+                            "elapsed_seconds": tp.elapsed_seconds,
+                            "operator": tp.operator,
+                            "stage_version": int(tp.stage_version),
+                        })
+            except Exception:  # noqa: BLE001 - best-effort
+                log.debug("progress fold failed", exc_info=True)
         jobs_touched = set(self.state.reap_lost_tasks())
         # lifecycle reap: expired server-side deadlines + the slow-query
         # killer (already-terminal, so not re-synchronized below)
@@ -811,6 +946,20 @@ class SchedulerService:
                 serde.stage_metrics_to_proto(
                     st.stage_metrics, result.status.completed.stage_metrics
                 )
+        # live progress snapshot (extended GetJobStatus): present while
+        # the tracker knows the job — the client's on_progress callback
+        # and ctx.job_progress() read it from here. Skipped entirely
+        # when the plane is disabled: status polls are a hot path and
+        # the off knob must actually take the work off it
+        from ..observability.progress import progress_interval_secs
+
+        if progress_interval_secs() is not None:
+            try:
+                snap = self.progress.snapshot(request.job_id)
+                if snap is not None:
+                    serde.job_progress_to_proto(snap, result.progress)
+            except Exception:  # noqa: BLE001 - advisory
+                log.debug("progress snapshot failed", exc_info=True)
         return result
 
     # -- RPC: GetJobProfile --------------------------------------------------

@@ -210,6 +210,13 @@ class SchedulerState:
         from ..observability.health import QueryLog
 
         self.query_log = QueryLog()
+        # live progress plane: /debug/queries + system.queries carry
+        # IN-FLIGHT rows (status "running", live wall seconds) next to
+        # the terminal ring entries
+        self.query_log.live_fn = self.live_query_records
+        # last-heartbeat wall times (scheduler-side clock): feeds the
+        # heartbeat_age_seconds / stale columns of system.executors
+        self._heartbeats: Dict[str, float] = {}
         self.jobs_submitted = 0
         self.jobs_completed = 0
         self.jobs_failed = 0
@@ -275,6 +282,8 @@ class SchedulerState:
     # -- executors ----------------------------------------------------------
 
     def save_executor_metadata(self, meta: ExecutorMeta):
+        with self._lock:
+            self._heartbeats[meta.id] = time.time()
         self.kv.put(self._k("executors", meta.id), pickle.dumps(meta),
                     lease_secs=EXECUTOR_LEASE_SECS)
         # durable (unleased) address record: shuffle locations must stay
@@ -293,6 +302,24 @@ class SchedulerState:
     def live_executor_ids(self) -> set:
         """Executors with an unexpired lease."""
         return {e.id for e in self.get_executors_metadata()}
+
+    def all_executor_metadata(self) -> List[ExecutorMeta]:
+        """Every executor ever registered, lease state ignored (the
+        durable address records): system.executors builds from this so
+        stale/dead executors stay VISIBLE from SQL instead of silently
+        vanishing with their lease."""
+        return [
+            pickle.loads(v)
+            for _, v in self.kv.get_from_prefix(
+                self._k("executors_meta") + "/")
+        ]
+
+    def executor_heartbeats(self) -> Dict[str, float]:
+        """executor id -> last PollWork wall time (this scheduler
+        lifetime; a restarted scheduler starts empty, so pre-restart
+        executors read as never-heartbeated until they poll again)."""
+        with self._lock:
+            return dict(self._heartbeats)
 
     def executor_address(self, executor_id: str) -> Optional[ExecutorMeta]:
         """Last-known address, regardless of lease state."""
@@ -363,6 +390,37 @@ class SchedulerState:
     def get_job_status(self, job_id: str) -> Optional[JobStatus]:
         v = self.kv.get(self._k("jobs", job_id))
         return pickle.loads(v) if v is not None else None
+
+    def job_started_at(self, job_id: str) -> Optional[float]:
+        """Submission wall time while the job is non-terminal (the
+        terminal transition pops it)."""
+        return self._job_started.get(job_id)
+
+    def live_query_records(self) -> List[dict]:
+        """In-flight query rows for /debug/queries + system.queries:
+        one per non-terminal job, status "queued"/"running" with LIVE
+        wall seconds. Overwritten by the terminal ring record the
+        moment the job finishes (the terminal transition pops
+        _job_started first)."""
+        from ..observability import systables
+
+        out = []
+        now = time.time()
+        for job_id, t0 in list(self._job_started.items()):
+            try:
+                js = self.get_job_status(job_id)
+            except Exception:  # noqa: BLE001 - diagnosis plane
+                continue
+            state = js.state if js is not None else "queued"
+            if state not in ("queued", "running"):
+                continue
+            out.append(systables.build_query_record(
+                job_id, state, now - t0,
+                plan_digest=self._job_digests.get(job_id),
+                num_stages=len(self.stage_ids(job_id)) or None,
+                started_at=t0, origin="cluster",
+            ))
+        return out
 
     def save_job_digest(self, job_id: str, digest: str):
         """Stable digest of the job's logical plan (in-memory, advisory:

@@ -12,7 +12,11 @@ threads only, one instance per process role:
   ``registry.PROCESS_METRICS`` (the renderer drops unknown names — the
   registry is the contract ``dev/check_metric_names.py`` lints).
 - ``GET /debug/queries`` — JSON ring buffer of recent query summaries
-  plus the slow-query subset (``BALLISTA_SLOW_QUERY_SECS``).
+  plus the slow-query subset (``BALLISTA_SLOW_QUERY_SECS``) and, when
+  a live provider is wired, IN-FLIGHT queries (status "running").
+- ``GET /debug/jobs[/<job_id>]`` — live job progress snapshots
+  (scheduler only; the progress plane's HTTP face — per-stage
+  completion fractions, rate-based ETA, task counts).
 - ``GET /debug/profile/<job_id>`` — the job's merged Chrome-trace
   profile artifact (scheduler only; served from the distributed
   profiler's collector, built on demand from the flight recorder when
@@ -79,6 +83,11 @@ class QueryLog:
         self._recent: deque = deque(maxlen=capacity)
         self._slow: deque = deque(maxlen=capacity)
         self.slow_total = 0
+        # live progress plane: optional provider of IN-FLIGHT query
+        # records (status "running", live wall seconds) appended to
+        # every snapshot — they vanish/are overwritten the moment the
+        # terminal record lands in the ring
+        self.live_fn = None
 
     def record(self, summary: dict) -> None:
         entry = dict(summary)
@@ -106,9 +115,15 @@ class QueryLog:
                         e.update(fields)
 
     def snapshot(self) -> dict:
+        live: List[dict] = []
+        if self.live_fn is not None:
+            try:
+                live = list(self.live_fn())
+            except Exception:  # noqa: BLE001 - advisory rows only
+                live = []
         with self._lock:
             return {
-                "queries": list(self._recent),
+                "queries": list(self._recent) + live,
                 "slow_queries": list(self._slow),
                 "slow_query_secs": slow_query_secs(),
                 "slow_total": self.slow_total,
@@ -204,13 +219,19 @@ class HealthServer:
                  query_log: Optional[QueryLog] = None,
                  host: str = "127.0.0.1",
                  profile_fn: Optional[Callable[[str],
-                                              Optional[dict]]] = None):
+                                              Optional[dict]]] = None,
+                 jobs_fn: Optional[Callable[[Optional[str]],
+                                            object]] = None):
         self.role = role
         self.query_log = query_log or QueryLog()
         self._samples_fn = samples_fn
         # profile_fn(job_id) -> merged profile artifact dict (or None):
         # serves /debug/profile/<job_id> on the scheduler
         self._profile_fn = profile_fn
+        # jobs_fn(None) -> live job progress snapshots, jobs_fn(id) ->
+        # one snapshot or None: serves /debug/jobs[/<job_id>] (live
+        # progress plane, scheduler only)
+        self._jobs_fn = jobs_fn
         self._started_at = time.time()
         plane = self
 
@@ -239,6 +260,23 @@ class HealthServer:
                         body = json.dumps(plane.query_log.snapshot(),
                                           default=str).encode()
                         self._send(200, body, "application/json")
+                    elif path == "/debug/jobs" and \
+                            plane._jobs_fn is not None:
+                        body = json.dumps(
+                            {"jobs": plane._jobs_fn(None)},
+                            default=str).encode()
+                        self._send(200, body, "application/json")
+                    elif path.startswith("/debug/jobs/") and \
+                            plane._jobs_fn is not None:
+                        jid = path[len("/debug/jobs/"):]
+                        # empty id ("/debug/jobs/") must 404, not leak
+                        # the whole-list shape through the falsy branch
+                        snap = plane._jobs_fn(jid) if jid else None
+                        if snap is None:
+                            self._send(404, b"unknown job", "text/plain")
+                        else:
+                            body = json.dumps(snap, default=str).encode()
+                            self._send(200, body, "application/json")
                     elif path.startswith("/debug/profile/") and \
                             plane._profile_fn is not None:
                         job_id = path[len("/debug/profile/"):]
@@ -306,14 +344,15 @@ def metrics_port_from_env(default: int = -1) -> int:
 
 def maybe_start_health_server(role: str, port: Optional[int],
                               samples_fn=None, query_log=None,
-                              profile_fn=None
+                              profile_fn=None, jobs_fn=None
                               ) -> Optional[HealthServer]:
     """Start a health server unless disabled (``port`` None/negative)."""
     if port is None or port < 0:
         return None
     try:
         return HealthServer(role, port, samples_fn=samples_fn,
-                            query_log=query_log, profile_fn=profile_fn)
+                            query_log=query_log, profile_fn=profile_fn,
+                            jobs_fn=jobs_fn)
     except OSError as e:
         log.warning("health plane for %s failed to bind port %s: %s",
                     role, port, e)

@@ -78,13 +78,15 @@ class MetricsSet:
     gauges (last/max value), plus a pending list of device row-count
     scalars resolved lazily at read time."""
 
-    __slots__ = ("_counters", "_timers", "_gauges", "_pending_rows")
+    __slots__ = ("_counters", "_timers", "_gauges", "_pending_rows",
+                 "_rows_floor")
 
     def __init__(self):
         self._counters: Dict[str, int] = {}
         self._timers: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._pending_rows: List = []
+        self._rows_floor = 0
 
     # recording (hot path) --------------------------------------------------
 
@@ -106,6 +108,7 @@ class MetricsSet:
         self._timers.clear()
         self._gauges.clear()
         self._pending_rows.clear()
+        self._rows_floor = 0
 
     def record_output_batch(self, batch) -> None:
         """Append the batch's (device-scalar) live row count without
@@ -119,6 +122,9 @@ class MetricsSet:
     def _resolve_rows(self) -> None:
         if not self._pending_rows:
             return
+        # NOTE: a snapshot_rows() racing this window (pending swapped
+        # out, sum not yet committed) computes a transiently LOW total;
+        # the _rows_floor clamp there keeps the sampled value monotone
         pending, self._pending_rows = self._pending_rows, []
         try:
             import jax
@@ -130,6 +136,39 @@ class MetricsSet:
             self._counters.get("output_rows", 0)
             + int(sum(int(c) for c in counts))
         )
+
+    def snapshot_rows(self) -> int:
+        """Non-destructive, non-blocking row count for the live
+        progress sampler: the committed counter plus the pending device
+        scalars that are ALREADY resolved. Never blocks on in-flight
+        compute (unready scalars are skipped) and never clears the
+        pending list, so ``values()`` keeps the authoritative
+        accounting. Monotone by clamp: a read racing ``_resolve_rows``
+        (pending swapped out, counter not yet bumped) would compute a
+        transiently low total, so the last returned value is a floor."""
+        total = int(self._counters.get("output_rows", 0))
+        ready = []
+        for p in list(self._pending_rows):
+            is_ready = getattr(p, "is_ready", None)
+            try:
+                if is_ready is None or is_ready():
+                    ready.append(p)
+            except Exception:  # noqa: BLE001 - deleted buffer etc.
+                continue
+        if ready:
+            try:
+                import jax
+
+                counts = jax.device_get(ready)
+            except Exception:  # noqa: BLE001 - already-host scalars
+                counts = ready
+            try:
+                total += int(sum(int(c) for c in counts))
+            except Exception:  # noqa: BLE001 - advisory only
+                pass
+        total = max(total, self._rows_floor)
+        self._rows_floor = total
+        return total
 
     def values(self) -> Dict[str, float]:
         """Resolved snapshot: counters as ints, timers/gauges as floats.

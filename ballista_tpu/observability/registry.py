@@ -104,6 +104,13 @@ PROCESS_METRICS = {
     "ballista_tasks_dispatched_total": ("counter", "task definitions "
                                                    "handed to executors"),
     "ballista_ready_queue_depth": ("gauge", "tasks in the ready queue"),
+    # live progress plane (scheduler)
+    "ballista_tasks_running": ("gauge", "tasks currently running across "
+                                        "all live jobs (progress "
+                                        "tracker view)"),
+    "ballista_job_progress_fraction": ("gauge", "per-live-job completion "
+                                                "fraction 0..1 (label "
+                                                "job=...)"),
     "ballista_slow_queries_total": ("counter", "completed queries over "
                                                "BALLISTA_SLOW_QUERY_SECS"),
     # scheduler-side aggregation of executor heartbeat gauges

@@ -142,6 +142,15 @@ KNOBS: Dict[str, tuple] = {
     "BALLISTA_QUERY_LOG_MAX_MB": ("16", "rotate the query-history log "
                                         "past this size (one rotated "
                                         "segment is kept)"),
+    # live progress & session metering plane (docs/observability.md)
+    "BALLISTA_PROGRESS_INTERVAL_SECS": ("1.0", "cadence of executor "
+                                               "TaskProgress piggybacks "
+                                               "and ambient standalone "
+                                               "sampling (0/off disables "
+                                               "the plane)"),
+    "BALLISTA_EXECUTOR_STALE_SECS": ("15", "heartbeat age past which "
+                                           "system.executors marks a row "
+                                           "stale=true"),
     # query lifecycle control plane (docs/robustness.md)
     "BALLISTA_SLOW_QUERY_KILL_SECS": ("off", "upgrade the slow-query log "
                                              "to a KILL: cancel queries "
@@ -231,10 +240,35 @@ SYSTEM_SCHEMAS: Dict[str, Schema] = {
         ("num_devices", Int64), ("rss_bytes", Int64),
         ("device_bytes", Int64), ("inflight_tasks", Int64),
         ("ingest_pool_depth", Int64), ("peak_host_bytes", Int64),
+        # live progress plane: scheduler-side clock minus the last
+        # heartbeat; stale=1 past BALLISTA_EXECUTOR_STALE_SECS (or when
+        # the executor never heartbeated this scheduler lifetime)
+        ("heartbeat_age_seconds", Float64), ("stale", Int64),
     ),
     "system.settings": make_schema(
         ("name", Utf8), ("value", Utf8), ("default", Utf8),
         ("source", Utf8), ("description", Utf8),
+    ),
+    # live progress plane (observability/progress.py): running tasks,
+    # per-stage completion fractions, cumulative per-session metering
+    "system.tasks": make_schema(
+        ("job_id", Utf8), ("stage_id", Int64), ("partition_id", Int64),
+        ("executor_id", Utf8), ("operator", Utf8),
+        ("rows_so_far", Int64), ("bytes_so_far", Int64),
+        ("elapsed_seconds", Float64),
+    ),
+    "system.stages": make_schema(
+        ("job_id", Utf8), ("stage_id", Int64), ("tasks_total", Int64),
+        ("tasks_running", Int64), ("tasks_completed", Int64),
+        ("fraction", Float64), ("eta_seconds", Float64),
+        ("rows_so_far", Int64), ("bytes_so_far", Int64),
+    ),
+    "system.sessions": make_schema(
+        ("session_id", Utf8), ("queries", Int64),
+        ("wall_seconds", Float64), ("task_seconds", Float64),
+        ("device_blocked_seconds", Float64), ("bytes_shuffled", Int64),
+        ("peak_host_bytes", Int64), ("peak_device_bytes", Int64),
+        ("started_at", Float64), ("last_active", Float64),
     ),
 }
 
@@ -440,6 +474,12 @@ def process_query_log():
             from .health import QueryLog
 
             _process_query_log = QueryLog()
+            # live progress plane: in-flight standalone collects show
+            # up as status="running" rows with live wall seconds
+            from . import progress as obs_progress
+
+            _process_query_log.live_fn = \
+                obs_progress.local_live_query_records
         return _process_query_log
 
 
@@ -452,6 +492,9 @@ def _reset_process_state_for_tests() -> None:
     _OPERATOR_STORE.clear()
     with _history_lock:
         _history_cache.clear()
+    from . import progress as obs_progress
+
+    obs_progress._reset_process_state_for_tests()
 
 
 class OperatorStore:
@@ -559,12 +602,14 @@ class StandaloneQueryRecorder:
     meaningfully (the < 5% warm-q1 gate covers this path, history log
     on AND off)."""
 
-    def __init__(self, plan):
+    def __init__(self, plan, session_id: str = ""):
         from ..compile import compile_stats
         from ..ingest import phase_totals
         from . import profiler as obs_profiler
+        from . import progress as obs_progress
 
         self.job_id = f"local-{os.getpid()}-{next(_local_job_ids)}"
+        self.session_id = session_id
         try:
             self.digest = obs_profiler.plan_digest(plan)
         except Exception:  # noqa: BLE001 - digest is advisory
@@ -573,6 +618,11 @@ class StandaloneQueryRecorder:
         self._phases0 = phase_totals()
         self._compile0 = compile_stats()
         self._t0 = time.time()
+        # live progress plane: register the collect with the in-flight
+        # surfaces (system.tasks/stages, running system.queries rows);
+        # the executed plan attaches once planned (attach_current_plan)
+        self.handle = obs_progress.start_local_query(
+            self.job_id, session_id, self.digest)
 
     def _lanes(self, wall: float) -> Optional[dict]:
         from . import tracing
@@ -603,6 +653,13 @@ class StandaloneQueryRecorder:
 
             logging.getLogger("ballista.systables").warning(
                 "query record failed for %s", self.job_id, exc_info=True)
+        finally:
+            from . import progress as obs_progress
+
+            try:
+                obs_progress.finish_local_query(self.handle, status)
+            except Exception:  # noqa: BLE001 - advisory
+                pass
 
     def _finish_inner(self, status, result, phys, error) -> None:
         from . import memory as obs_memory
@@ -640,6 +697,21 @@ class StandaloneQueryRecorder:
         if phys is not None and status == "completed":
             _OPERATOR_STORE.record(self.job_id, self.digest,
                                    plan_metrics_provider(phys))
+        # per-session metering (system.sessions): the standalone face
+        # of the scheduler's terminal-transition accumulation; wall
+        # doubles as task seconds (one in-process "task")
+        from . import progress as obs_progress
+
+        obs_progress.process_session_meter().record(
+            self.session_id,
+            wall_seconds=wall,
+            task_seconds=wall,
+            device_blocked_seconds=(lanes or {}).get(
+                "device_blocked", 0.0),
+            bytes_shuffled=0,
+            peak_host_bytes=obs_memory.peak_host_bytes(),
+            peak_device_bytes=obs_memory.peak_device_bytes(),
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -735,7 +807,28 @@ def _local_executor_rows() -> List[dict]:
         "inflight_tasks": 0,
         "ingest_pool_depth": pool_queue_depth(),
         "peak_host_bytes": obs_memory.peak_host_bytes(),
+        # the current process IS the executor: its heartbeat is now
+        "heartbeat_age_seconds": 0.0,
+        "stale": 0,
     }]
+
+
+def _local_tasks_rows() -> List[dict]:
+    from . import progress as obs_progress
+
+    return obs_progress.local_task_rows()
+
+
+def _local_stages_rows() -> List[dict]:
+    from . import progress as obs_progress
+
+    return obs_progress.local_stage_rows()
+
+
+def _session_rows() -> List[dict]:
+    from . import progress as obs_progress
+
+    return obs_progress.process_session_meter().rows()
 
 
 class SystemSnapshot:
@@ -745,10 +838,18 @@ class SystemSnapshot:
     other surfaces read."""
 
     def __init__(self, query_log=None, operators: Optional[OperatorStore] = None,
-                 executors_fn: Optional[Callable[[], List[dict]]] = None):
+                 executors_fn: Optional[Callable[[], List[dict]]] = None,
+                 tasks_fn: Optional[Callable[[], List[dict]]] = None,
+                 stages_fn: Optional[Callable[[], List[dict]]] = None,
+                 sessions_fn: Optional[Callable[[], List[dict]]] = None):
         self._query_log = query_log
         self._operators = operators
         self._executors_fn = executors_fn or _local_executor_rows
+        # live progress plane: the scheduler wires its JobProgressTracker
+        # here; the standalone defaults read the local query handles
+        self._tasks_fn = tasks_fn or _local_tasks_rows
+        self._stages_fn = stages_fn or _local_stages_rows
+        self._sessions_fn = sessions_fn or _session_rows
 
     def table_rows(self, table: str) -> List[dict]:
         if table not in SYSTEM_SCHEMAS:
@@ -763,6 +864,12 @@ class SystemSnapshot:
             return _compile_rows()
         if table == "system.executors":
             return self._executors_fn()
+        if table == "system.tasks":
+            return self._tasks_fn()
+        if table == "system.stages":
+            return self._stages_fn()
+        if table == "system.sessions":
+            return self._sessions_fn()
         return settings_rows()
 
 

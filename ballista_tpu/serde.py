@@ -816,3 +816,66 @@ def task_profile_from_proto(msg: "pb.TaskProfile") -> Optional[dict]:
         "compile": _load(msg.compile_json, {}),
         "memory": _load(msg.memory_json, {}),
     }
+
+
+# -- live progress plane: job/stage progress snapshots ------------------------
+# Python shape (observability/progress.py snapshot contract — ONE shape
+# on both paths): {"job_id", "status", "fraction", "eta_seconds"
+# (None = unknown), "wall_seconds", "tasks_total", "tasks_running",
+# "tasks_queued", "tasks_completed", "stages": [{"stage_id",
+# "tasks_total", "tasks_running", "tasks_completed", "fraction",
+# "eta_seconds", "rows_so_far", "bytes_so_far"}, ...]}.
+
+
+def job_progress_to_proto(snap: dict, msg: "pb.JobProgress") -> None:
+    def _eta(v):
+        return -1.0 if v is None else float(v)
+
+    msg.fraction = float(snap.get("fraction", 0.0))
+    msg.eta_seconds = _eta(snap.get("eta_seconds"))
+    msg.wall_seconds = float(snap.get("wall_seconds", 0.0))
+    msg.tasks_total = int(snap.get("tasks_total", 0))
+    msg.tasks_running = int(snap.get("tasks_running", 0))
+    msg.tasks_queued = int(snap.get("tasks_queued", 0))
+    msg.tasks_completed = int(snap.get("tasks_completed", 0))
+    for st in snap.get("stages") or []:
+        sp = msg.stages.add()
+        sp.stage_id = int(st.get("stage_id", 0))
+        sp.tasks_total = int(st.get("tasks_total", 0))
+        sp.tasks_running = int(st.get("tasks_running", 0))
+        sp.tasks_completed = int(st.get("tasks_completed", 0))
+        sp.fraction = float(st.get("fraction", 0.0))
+        sp.eta_seconds = _eta(st.get("eta_seconds"))
+        sp.rows_so_far = int(st.get("rows_so_far") or 0)
+        sp.bytes_so_far = int(st.get("bytes_so_far") or 0)
+
+
+def job_progress_from_proto(msg: "pb.JobProgress", job_id: str = "",
+                            status: str = "running") -> dict:
+    def _eta(v):
+        return None if v < 0 else float(v)
+
+    return {
+        "job_id": job_id,
+        "status": status,
+        "fraction": msg.fraction,
+        "eta_seconds": _eta(msg.eta_seconds),
+        "wall_seconds": msg.wall_seconds,
+        "tasks_total": msg.tasks_total,
+        "tasks_running": msg.tasks_running,
+        "tasks_queued": msg.tasks_queued,
+        "tasks_completed": msg.tasks_completed,
+        "stages": [
+            {
+                "stage_id": sp.stage_id,
+                "tasks_total": sp.tasks_total,
+                "tasks_running": sp.tasks_running,
+                "tasks_completed": sp.tasks_completed,
+                "fraction": sp.fraction,
+                "eta_seconds": _eta(sp.eta_seconds),
+                "rows_so_far": sp.rows_so_far,
+                "bytes_so_far": sp.bytes_so_far,
+            }
+            for sp in msg.stages
+        ],
+    }

@@ -41,6 +41,10 @@ dataplane.serve      data-plane request handler (drop = close without a
                      response; fail = error response)
 state.save           scheduler state task-status persistence
 client.rpc           every SchedulerClient RPC, client side
+scheduler.progress_report  executor-side TaskProgress piggyback assembly
+                     (drop = skip this round's samples, delay = stall
+                     them, fail = swallowed — progress is best-effort
+                     and results must stay byte-identical)
 ==================== =======================================================
 
 Disabled cost: one module-global ``is None`` check per hit — the
@@ -68,6 +72,8 @@ FAULT_POINTS: Dict[str, str] = {
     "dataplane.serve": "data-plane request handler",
     "state.save": "scheduler task-status persistence",
     "client.rpc": "SchedulerClient RPC, client side",
+    "scheduler.progress_report": "executor TaskProgress piggyback "
+                                 "assembly (live progress plane)",
 }
 
 

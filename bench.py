@@ -74,7 +74,8 @@ def cold_phase_split(run_fn):
 
 
 def profiled_query(ctx, name: str, sql: str, runs: int, result: dict,
-                   timed, lane_prefix: str) -> None:
+                   timed, lane_prefix: str,
+                   progress_field: str = "") -> None:
     """Shared TPC-H query measurement: the FIRST run executes under a
     profiler window so the named wall-time lanes land in the JSON line
     (`{lane_prefix}device_blocked_seconds` etc. — q5 keeps the
@@ -93,7 +94,18 @@ def profiled_query(ctx, name: str, sql: str, runs: int, result: dict,
         prof = None
     try:
         df = ctx.sql(sql)
-        first = timed(df)  # load + compile
+        if progress_field:
+            # live progress plane: count the on_progress callbacks the
+            # first (cold) run delivers — pins that the sampler stays
+            # alive on the bench workload (gated as higher-is-better by
+            # dev/check_bench_regress.py)
+            samples = []
+            t0 = time.time()
+            df.collect(on_progress=samples.append)
+            first = time.time() - t0
+            result[progress_field] = len(samples)
+        else:
+            first = timed(df)  # load + compile
         if prof is not None:
             try:
                 from ballista_tpu.observability.export import compute_lanes
@@ -570,7 +582,8 @@ def _run_bench(args) -> None:
     qdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "benchmarks", "tpch", "queries")
     profiled_query(ctx, "q5", open(os.path.join(qdir, "q5.sql")).read(),
-                   args.runs, result, timed, lane_prefix="")
+                   args.runs, result, timed, lane_prefix="",
+                   progress_field="progress_samples")
     if "q5_warm_seconds" in result:
         result["q5_rows_per_sec"] = round(
             total_rows / result["q5_warm_seconds"], 1)

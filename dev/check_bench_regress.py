@@ -63,6 +63,13 @@ METRICS: Dict[str, Tuple[str, float]] = {
     "q18_compile_trace_lower_seconds": ("lower", 0.45),
     # resource envelope
     "peak_rss_mb": ("lower", 0.30),
+    # live progress plane (PR 10): on_progress callbacks delivered
+    # during the cold q5 run — a sampler that silently dies would read
+    # 0. "nonzero": only 0 regresses. The raw count scales with cold-run
+    # wall time, so a ratio gate would punish legitimate cold-time
+    # speedups. Absent from pre-PR-10 baselines (compare() skips
+    # missing keys).
+    "progress_samples": ("nonzero", 0.0),
 }
 
 
@@ -108,6 +115,13 @@ def compare(old: dict, new: dict, tolerance_scale: float = 1.0) -> list:
                          None, False, False))
             continue
         o, n = float(old[metric]), float(new[metric])
+        if direction == "nonzero":
+            # aliveness gate: regress only when a previously-reporting
+            # metric reads 0 now (magnitude is wall-time-coupled noise)
+            regressed = o > 0 and n <= 0
+            rows.append((metric, o, n, 1.0 if regressed else 0.0,
+                         regressed, True))
+            continue
         if o <= 0:
             rows.append((metric, o, n, None, False, False))
             continue
@@ -167,6 +181,14 @@ def self_test() -> int:
     # zero/absent baselines are skipped (cannot compute a ratio)
     assert not any(r[4] for r in compare({"value": 0.0},
                                          {"value": 10.0}))
+    # nonzero metrics: only a drop to 0 regresses — a faster cold run
+    # delivering FEWER samples must never fail the gate
+    rows = {r[0]: r for r in compare({"progress_samples": 8},
+                                     {"progress_samples": 2})}
+    assert rows["progress_samples"][4] is False
+    rows = {r[0]: r for r in compare({"progress_samples": 8},
+                                     {"progress_samples": 0})}
+    assert rows["progress_samples"][4] is True
     print("self-test ok")
     return 0
 

@@ -247,6 +247,50 @@ def apply_lifecycle(fdp: dp.FileDescriptorProto) -> None:
         add_field(m, "state", 2, F.TYPE_STRING)
 
 
+def apply_progress(fdp: dp.FileDescriptorProto) -> None:
+    """PR 10: live query progress plane (mirrored by hand in
+    ballista.proto; dev/check_proto_sync.py guards the drift) — compact
+    per-task progress samples piggybacked on the PollWork heartbeat,
+    and the live job progress model served through GetJobStatus."""
+    if not has_message(fdp, "TaskProgress"):
+        m = fdp.message_type.add(name="TaskProgress")
+        add_field(m, "partition_id", 1, F.TYPE_MESSAGE,
+                  type_name=".ballista_tpu.PartitionId")
+        add_field(m, "stage_version", 2, F.TYPE_UINT32)
+        add_field(m, "operator", 3, F.TYPE_STRING)
+        add_field(m, "rows_so_far", 4, F.TYPE_UINT64)
+        add_field(m, "input_rows_total", 5, F.TYPE_UINT64)
+        add_field(m, "bytes_so_far", 6, F.TYPE_UINT64)
+        add_field(m, "elapsed_seconds", 7, F.TYPE_DOUBLE)
+    add_field(get_message(fdp, "PollWorkParams"), "task_progress", 4,
+              F.TYPE_MESSAGE, type_name=".ballista_tpu.TaskProgress",
+              repeated=True)
+
+    if not has_message(fdp, "StageProgress"):
+        m = fdp.message_type.add(name="StageProgress")
+        add_field(m, "stage_id", 1, F.TYPE_UINT32)
+        add_field(m, "tasks_total", 2, F.TYPE_UINT32)
+        add_field(m, "tasks_running", 3, F.TYPE_UINT32)
+        add_field(m, "tasks_completed", 4, F.TYPE_UINT32)
+        add_field(m, "fraction", 5, F.TYPE_DOUBLE)
+        add_field(m, "eta_seconds", 6, F.TYPE_DOUBLE)
+        add_field(m, "rows_so_far", 7, F.TYPE_UINT64)
+        add_field(m, "bytes_so_far", 8, F.TYPE_UINT64)
+    if not has_message(fdp, "JobProgress"):
+        m = fdp.message_type.add(name="JobProgress")
+        add_field(m, "fraction", 1, F.TYPE_DOUBLE)
+        add_field(m, "eta_seconds", 2, F.TYPE_DOUBLE)
+        add_field(m, "wall_seconds", 3, F.TYPE_DOUBLE)
+        add_field(m, "tasks_total", 4, F.TYPE_UINT32)
+        add_field(m, "tasks_running", 5, F.TYPE_UINT32)
+        add_field(m, "tasks_queued", 6, F.TYPE_UINT32)
+        add_field(m, "tasks_completed", 7, F.TYPE_UINT32)
+        add_field(m, "stages", 8, F.TYPE_MESSAGE,
+                  type_name=".ballista_tpu.StageProgress", repeated=True)
+    add_field(get_message(fdp, "GetJobStatusResult"), "progress", 2,
+              F.TYPE_MESSAGE, type_name=".ballista_tpu.JobProgress")
+
+
 TEMPLATE = '''# -*- coding: utf-8 -*-
 # Generated by dev/gen_proto_patch.py (no protoc in this image). DO NOT EDIT!
 # source: ballista.proto
@@ -279,6 +323,7 @@ def main() -> None:
     apply_profiler(fdp)
     apply_systables(fdp)
     apply_lifecycle(fdp)
+    apply_progress(fdp)
     out = TEMPLATE.format(blob=fdp.SerializeToString())
     with open(PB2, "w") as f:
         f.write(out)

@@ -706,6 +706,15 @@ CHAOS_SEEDS = {
                             {"job.deadline": "1.0"}, {}, False),
     "dataplane-drop": ("dataplane.serve=drop-once", {},
                        {"BALLISTA_NATIVE_DATAPLANE": "off"}, False),
+    # live progress plane: dropped or delayed TaskProgress piggybacks
+    # are advisory by contract — results MUST stay byte-identical (the
+    # tight interval forces every poll to attempt a sample)
+    "progress-drop": ("scheduler.progress_report=drop-every:1", {},
+                      {"BALLISTA_PROGRESS_INTERVAL_SECS": "0.05"}, True),
+    "progress-delay": ("scheduler.progress_report=delay:50", {},
+                       {"BALLISTA_PROGRESS_INTERVAL_SECS": "0.05"}, True),
+    "progress-fail": ("scheduler.progress_report=fail-every:1", {},
+                      {"BALLISTA_PROGRESS_INTERVAL_SECS": "0.05"}, True),
 }
 
 

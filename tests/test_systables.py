@@ -74,7 +74,11 @@ def test_system_queries_standalone(ctx, clean_env):
     q = _fresh_select(
         ctx, "SELECT job_id, plan_digest, status, wall_seconds, "
              "output_rows, origin FROM system.queries")
-    row = q.iloc[-1]
+    # the SELECT over system.queries is itself an in-flight
+    # status="running" row — assert on the last *completed* query
+    done = q[q["status"] == "completed"]
+    assert len(done) >= 1
+    row = done.iloc[-1]
     assert row["status"] == "completed"
     assert row["origin"] == "standalone"
     assert row["job_id"].startswith("local-")
