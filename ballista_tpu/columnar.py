@@ -36,6 +36,14 @@ from .errors import ExecutionError, SchemaError
 # Default physical batch capacity (rows). Power of two keeps XLA tilings happy.
 DEFAULT_BATCH_CAPACITY = 1 << 20
 
+# Dictionary.values_str() keeps its fixed-width str view only under this
+# size — a comment-scale dictionary's view would pin hundreds of MB.
+_STR_CACHE_CAP_BYTES = 256 << 20
+
+# FNV-1a constants (stable_hashes)
+_FNV_OFFSET = np.uint64(0xCBF29CE484222325)
+_FNV_PRIME = np.uint64(0x100000001B3)
+
 
 def round_capacity(n: int, minimum: int = 8) -> int:
     """Smallest power of two >= n (>= minimum).
@@ -111,11 +119,21 @@ class Dictionary:
     ride in pytree aux-data without defeating jit caching.
     """
 
-    __slots__ = ("values", "_index", "_tracked_bytes", "_aot_fp")
+    __slots__ = ("values", "_index", "_tracked_bytes", "_aot_fp",
+                 "_str_cache", "_hash_cache", "_str_exact",
+                 "_reg_entry_id", "_reg_version", "_reg_epoch")
 
     def __init__(self, values: Sequence[str]):
         self.values: np.ndarray = np.asarray(list(values), dtype=object)
         self._index: Dict[str, int] = {v: i for i, v in enumerate(self.values)}
+        # lazily-computed caches + dictionary-registry identity
+        # (columnar_registry.py stamps entry/version/epoch on members)
+        self._str_cache: Optional[np.ndarray] = None
+        self._hash_cache: Optional[np.ndarray] = None
+        self._str_exact: Optional[bool] = None
+        self._reg_entry_id: Optional[str] = None
+        self._reg_version: Optional[int] = None
+        self._reg_epoch: Optional[str] = None
         # memory accounting (observability/memory.py): dictionaries are
         # the dominant host-resident string mass — ~pointer array +
         # index dict entry + string storage per value (estimate, not an
@@ -173,17 +191,103 @@ class Dictionary:
             fp = self._aot_fp = h.hexdigest()
         return fp
 
+    # -- cached views / search primitives ----------------------------------
+    #
+    # Every host-side string operation funnels through these so the
+    # fixed-width str materialization and the per-value hash pass are
+    # paid ONCE per immutable instance instead of once per call site
+    # (join remap, concat/ipc unify and scan encode each used to
+    # ``.astype(str)`` the same values on every invocation).
+
+    def values_str(self) -> np.ndarray:
+        """Fixed-width ``np.str_`` view of the (sorted) values, cached.
+        Dictionaries past the cache cap recompute per call — the cached
+        view for a multi-million-value comment dictionary would pin
+        hundreds of MB of host RAM."""
+        sv = self._str_cache
+        if sv is None:
+            sv = self.values.astype(str)
+            if sv.nbytes <= _STR_CACHE_CAP_BYTES:
+                self._cache_str_view(sv)
+        return sv
+
+    def _cache_str_view(self, sv: np.ndarray) -> None:
+        """Pin a str view on the instance, keeping the 'dictionaries'
+        host-memory plane honest (the view can be several times the
+        object-string mass; __del__ releases the accumulated total)."""
+        if self._str_cache is None:
+            self._str_cache = sv
+            self._track_extra(int(sv.nbytes))
+
+    def _track_extra(self, nbytes: int) -> None:
+        from .observability import memory as _obs_memory
+
+        self._tracked_bytes += nbytes
+        _obs_memory.record_host_bytes("dictionaries", nbytes)
+
+    def positions_of(self, values) -> np.ndarray:
+        """int32 code per value via one sorted search over the cached
+        str view. Scan encode paths call this with values the
+        dictionary was built FROM (presence guaranteed); absent values
+        get the insertion position, exactly like the searchsorted
+        calls this replaces."""
+        vals = np.asarray(values)
+        if vals.dtype.kind != "U":
+            vals = vals.astype(str)
+        return np.searchsorted(self.values_str(), vals).astype(np.int32)
+
+    def code_range(self, s: str) -> Tuple[int, int]:
+        """(left, right) insertion bounds of ``s`` in code space —
+        string ordering predicates compile to code comparisons against
+        these (kernels/expr_eval.py)."""
+        sv = self.values_str()
+        return (int(np.searchsorted(sv, s, side="left")),
+                int(np.searchsorted(sv, s, side="right")))
+
     def stable_hashes(self) -> np.ndarray:
         """int64 FNV-1a hash per dictionary value — STABLE across processes
         and dictionary encodings, so hash partitioning of utf8 columns
         agrees between independent producers (codes are producer-local;
-        string hashes are not)."""
-        out = np.empty(len(self.values), dtype=np.int64)
-        for i, v in enumerate(self.values):
-            h = 0xCBF29CE484222325
-            for b in str(v).encode("utf-8"):
-                h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
-            out[i] = np.int64(np.uint64(h))
+        string hashes are not).
+
+        Vectorized: the hash recurrence runs per BYTE POSITION over all
+        values at once (a max-width pass of numpy uint64 ops) instead
+        of a per-value per-byte Python loop — this sits on the shuffle
+        partitioning path. Cached per immutable instance. Values the
+        fixed-width str view cannot represent (trailing U+0000) hash
+        through the reference scalar loop so placement never moves."""
+        cached = self._hash_cache
+        if cached is not None:
+            return cached
+        n = len(self.values)
+        if n == 0:
+            out = np.empty(0, dtype=np.int64)
+            self._hash_cache = out
+            return out
+        sv = self.values_str()
+        enc = np.char.encode(sv, "utf-8")
+        width = enc.dtype.itemsize
+        h = np.full(n, _FNV_OFFSET, dtype=np.uint64)
+        if width:
+            mat = enc.view(np.uint8).reshape(n, width)
+            nz = mat != 0
+            lengths = np.where(nz.any(axis=1),
+                               width - np.argmax(nz[:, ::-1], axis=1), 0)
+            for j in range(width):
+                active = j < lengths
+                h = np.where(active, (h ^ mat[:, j]) * _FNV_PRIME, h)
+        out = h.astype(np.int64)
+        # rows whose true length the str view lost (trailing NULs)
+        lens = np.fromiter((len(str(v)) for v in self.values),
+                           dtype=np.int64, count=n)
+        mangled = np.nonzero(lens != np.char.str_len(sv))[0]
+        for i in mangled:
+            hh = 0xCBF29CE484222325
+            for b in str(self.values[i]).encode("utf-8"):
+                hh = ((hh ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+            out[i] = np.int64(np.uint64(hh))
+        self._hash_cache = out
+        self._track_extra(int(out.nbytes))
         return out
 
     @staticmethod
@@ -504,6 +608,10 @@ def decode_physical_array(
     if kind == "utf8":
         if dictionary_values is None:
             raise ExecutionError("utf8 decode requires a dictionary")
+        if isinstance(dictionary_values, Dictionary):
+            # IPC readers hand back registry-resolved Dictionary
+            # objects; decode sees their value array either way
+            dictionary_values = dictionary_values.values
         dv = np.asarray(dictionary_values, dtype=object)
         codes = np.asarray(vals).astype(np.int64)
         ok = (codes >= 0) & (codes < len(dv))

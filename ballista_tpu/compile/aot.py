@@ -44,6 +44,8 @@ import pickle
 import threading
 from typing import Any, Iterator, List, Optional, Tuple
 
+import numpy as np
+
 log = logging.getLogger("ballista.compile.aot")
 
 _MISS = object()  # sentinel: no artifact for this call
@@ -210,9 +212,17 @@ def _args_fingerprint(args: tuple) -> str:
             return ("dict",) + tuple(
                 (str(k), walk(obj[k])) for k in sorted(obj))
         if isinstance(obj, ColumnBatch):
+            # dictionary identity = registry epoch (a vectorized content
+            # fingerprint, cached per instance): O(1) at call time, and
+            # a registry APPEND mints a new epoch for new versions while
+            # batches still carrying older versions keep their keys — so
+            # dictionary churn no longer re-keys (or re-hashes, via the
+            # old per-value Python loop) exported programs
+            from ..columnar_registry import fingerprint as _dict_fp
+
             return ("batch", repr(obj.schema), tuple(
                 (repr(c.dtype), c.validity is not None,
-                 c.dictionary.content_fingerprint()
+                 _dict_fp(c.dictionary)
                  if c.dictionary is not None else None,
                  tuple(c.values.shape), str(c.values.dtype))
                 for c in obj.columns))
@@ -241,16 +251,22 @@ def _args_fingerprint(args: tuple) -> str:
 
 def _encode_out(obj) -> tuple:
     """Abstract output -> picklable structural proto. Dictionaries are
-    stored by VALUE (plain lists) so unpickling reconstructs them via
-    ``Dictionary.__init__`` and the memory accounting stays balanced."""
+    stored by VALUE (plain lists) plus their registry stamp, so loading
+    resolves the interned in-process instance (identity shared with the
+    scans — downstream identity-keyed caches and unify no-ops keep
+    working across an AOT load) and only builds a fresh ``Dictionary``
+    when the stamp misses."""
     from ..columnar import ColumnBatch
+    from ..columnar_registry import REGISTRY
 
     if obj is None:
         return ("none",)
     if isinstance(obj, ColumnBatch):
         return ("batch", obj.schema, tuple(
             (c.dtype, c.validity is not None,
-             None if c.dictionary is None else list(c.dictionary.values))
+             None if c.dictionary is None
+             else (list(c.dictionary.values),
+                   REGISTRY.stamp_of(c.dictionary)))
             for c in obj.columns))
     if isinstance(obj, (tuple, list)):
         return ("seq", isinstance(obj, tuple),
@@ -275,14 +291,18 @@ def _encode_out(obj) -> tuple:
 
 
 def _materialize_dicts(proto: tuple) -> tuple:
-    """Proto -> proto with Dictionary objects built ONCE (per loaded
-    artifact), so every call reuses the same identity."""
-    from ..columnar import Dictionary
+    """Proto -> proto with Dictionary objects resolved ONCE: a registry
+    stamp (or matching content epoch) yields the live interned
+    instance; otherwise the values are adopted so every artifact (and
+    every later load) shares one identity per content."""
+    from ..columnar_registry import REGISTRY
 
     kind = proto[0]
     if kind == "batch":
         metas = tuple(
-            (dt, hv, Dictionary(dv) if dv is not None else None)
+            (dt, hv,
+             REGISTRY.adopt(dv[1], np.asarray(dv[0], dtype=object))
+             if dv is not None else None)
             for dt, hv, dv in proto[2])
         return ("batch", proto[1], metas)
     if kind == "seq":

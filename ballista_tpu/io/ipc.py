@@ -80,6 +80,14 @@ def batch_to_arrow(batch: ColumnBatch):
         if f.dtype.kind == "utf8":
             if col.dictionary is None:
                 raise IoError(f"utf8 column {f.name} without dictionary")
+            # registry stamp (entry:version:epoch): a reader in this or
+            # any sibling process resolves the SAME interned instance
+            # instead of re-hydrating values from the wire
+            from .. import columnar_registry
+
+            stamp = columnar_registry.REGISTRY.stamp_of(col.dictionary)
+            if stamp is not None:
+                meta[b"ballista.dict"] = stamp.encode()
             codes = pa.array(vals.astype(np.int32), mask=nulls)
             dict_vals = pa.array(
                 [str(v) for v in col.dictionary.values], type=pa.string()
@@ -245,7 +253,23 @@ def read_partition_arrays(
         if pa.types.is_dictionary(chunk.type):
             codes = chunk.indices.to_numpy(zero_copy_only=False).astype(np.int32)
             null_mask = np.asarray(chunk.indices.is_null())
-            dicts[name] = np.asarray(chunk.dictionary.to_pylist(), dtype=object)
+            # a registry stamp resolves to the live interned Dictionary
+            # (content-verified by epoch) without touching the shipped
+            # values; otherwise adopt them once per content epoch so
+            # every part/read of equal content shares ONE instance
+            from .. import columnar_registry as _reg
+
+            stamp = meta.get(b"ballista.dict", b"").decode() or None
+            resolved = _reg.REGISTRY.resolve(stamp)
+            if resolved is None and _reg.enabled():
+                resolved = _reg.REGISTRY.adopt(
+                    stamp,
+                    np.asarray(chunk.dictionary.to_pylist(), dtype=object))
+            if resolved is not None:
+                dicts[name] = resolved
+            else:  # registry off: legacy raw value array
+                dicts[name] = np.asarray(chunk.dictionary.to_pylist(),
+                                         dtype=object)
             arrays[name] = np.where(null_mask, 0, codes).astype(np.int32)
             kinds[name] = ("utf8", 0)
         elif pa.types.is_fixed_size_list(chunk.type):
@@ -274,24 +298,21 @@ def read_partition_arrays(
 
 
 def unify_dictionaries(
-    parts: List[Tuple[np.ndarray, np.ndarray]]
+    parts: List[Tuple[np.ndarray, "Dictionary | np.ndarray"]]
 ) -> Tuple[Dictionary, List[np.ndarray]]:
-    """[(codes, dict_values)] from several producers -> (union Dictionary,
-    remapped codes per part). Sorted union keeps codes ordinal."""
+    """[(codes, Dictionary-or-raw-values)] from several producers ->
+    (shared Dictionary, remapped codes per part). Sorted union keeps
+    codes ordinal. Routed through the dictionary registry: producers
+    of one table resolve to ONE interned instance (no remap at all),
+    version chains remap through cached integer tables, and only
+    unregistered content pays a (cached) sorted union."""
     from ..observability.tracing import trace_span
+    from .. import columnar_registry
 
+    if not parts:
+        return Dictionary([]), []
     with trace_span("host.dictionary", site="ipc.unify", n_parts=len(parts)):
-        union = np.unique(np.concatenate([d for _, d in parts])) \
-            if parts else np.asarray([], object)
-        out_dict = Dictionary(union)
-        remapped = []
-        union_str = union.astype(str)
-        for codes, dvals in parts:
-            remap = np.searchsorted(union_str,
-                                    np.asarray(dvals).astype(str))
-            remapped.append(remap[codes].astype(np.int32)
-                            if len(dvals) else codes)
-        return out_dict, remapped
+        return columnar_registry.unify_parts(parts)
 
 
 def batches_from_parts(

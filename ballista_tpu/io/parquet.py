@@ -86,6 +86,12 @@ class ParquetSource(TableSource):
             schema = Schema(fields)
         self._schema = schema
         self._dicts: Dict[str, Dictionary] = {}
+        # dictionary-registry entry identity (see io/text.py): same
+        # parquet files -> shared interned dictionaries per column
+        from .. import columnar_registry
+
+        self._dict_key_base = columnar_registry.file_entry_key(
+            "parquet", path, self._files)
         # concurrent partition scans (parallel ingest) share one
         # dictionary instance per column; per-COLUMN locks so builds of
         # distinct columns overlap on the ingest pool (each build reads
@@ -117,11 +123,18 @@ class ParquetSource(TableSource):
     def _dictionary_for(self, colname: str) -> Dictionary:
         import pyarrow.parquet as pq
 
+        from .. import columnar_registry
+
         if colname in self._dicts:  # fast path once built
             return self._dicts[colname]
         with self._dict_locks.get(colname):
             if colname in self._dicts:
                 return self._dicts[colname]
+            key = self._dict_key_base + (colname,)
+            d = columnar_registry.REGISTRY.lookup(key)
+            if d is not None:
+                self._dicts[colname] = d
+                return d
             with phase("parse"):
                 uniq: Optional[np.ndarray] = None
                 for f in self._files:
@@ -132,10 +145,12 @@ class ParquetSource(TableSource):
                     vals = np.asarray(
                         ["" if v is None else v
                          for v in t.column(0).to_pylist()], dtype=object)
-                    u = np.unique(vals)
+                    u = np.unique(vals)  # dict-ok: raw-value dict build
                     uniq = (u if uniq is None
-                            else np.unique(np.concatenate([uniq, u])))
-                d = Dictionary(uniq if uniq is not None else [])
+                            else np.unique(  # dict-ok: raw-value build
+                                np.concatenate([uniq, u])))
+                d = columnar_registry.intern(
+                    key, uniq if uniq is not None else [])
                 self._dicts[colname] = d
                 return d
 
@@ -167,9 +182,7 @@ class ParquetSource(TableSource):
                     vals = np.asarray(
                         ["" if v is None else v for v in colarr.to_pylist()],
                         dtype=object)
-                    codes = np.searchsorted(d.values.astype(str),
-                                            vals.astype(str))
-                    arrays[name] = codes.astype(np.int32)
+                    arrays[name] = d.positions_of(vals)
                     dicts[name] = d
                 elif field.dtype.kind == "decimal":
                     from ..columnar import decimal_to_scaled

@@ -74,6 +74,14 @@ class DelimitedSource(TableSource):
         self._capacity = batch_capacity
         self._files = _list_files(path)
         self._dicts: Dict[str, Dictionary] = {}
+        # dictionary-registry entry identity: every source instance
+        # over the same table files (re-registrations, self-join
+        # re-scans, executor tasks in one process) shares interned
+        # dictionaries, so codes are comparable by construction
+        from .. import columnar_registry
+
+        self._dict_key_base = columnar_registry.file_entry_key(
+            "text", path, self._files)
         # parallel ingest runs partitions of one table (and self-joined
         # re-scans) concurrently: dictionary builds must publish exactly
         # one instance per column (codes stay comparable across batches
@@ -154,10 +162,24 @@ class DelimitedSource(TableSource):
         with self._dict_lock:
             self._build_native_dicts_locked(colnames)
 
+    def _dict_key(self, colname: str) -> tuple:
+        return self._dict_key_base + (colname,)
+
     def _build_native_dicts_locked(self, colnames: List[str]) -> None:
         from . import native
+        from .. import columnar_registry
 
-        need = [n for n in colnames if n not in self._dicts]
+        need = []
+        for n in colnames:
+            if n in self._dicts:
+                continue
+            # a sibling source over the same files already paid for
+            # this build: reuse the interned dictionary outright
+            d = columnar_registry.REGISTRY.lookup(self._dict_key(n))
+            if d is not None:
+                self._dicts[n] = d
+            else:
+                need.append(n)
         if not need:
             return
         uniq: Dict[str, Optional[np.ndarray]] = {n: None for n in need}
@@ -174,22 +196,34 @@ class DelimitedSource(TableSource):
                     u = fd.get(n)
                     if u is None or len(u) == 0:
                         continue
-                    uniq[n] = (u if uniq[n] is None
-                               else np.unique(np.concatenate([uniq[n], u])))
+                    uniq[n] = (
+                        u if uniq[n] is None
+                        else np.unique(  # dict-ok: raw-value dict build
+                            np.concatenate([uniq[n], u])))
                 if mb < 0:
                     break
                 off += STREAM_CHUNK_BYTES
                 if off >= size:
                     break
         for n in need:
-            self._dicts[n] = Dictionary(uniq[n] if uniq[n] is not None else [])
+            self._dicts[n] = columnar_registry.intern(
+                self._dict_key(n),
+                uniq[n] if uniq[n] is not None else [])
 
     def _dictionary_for(self, colname: str) -> Dictionary:
-        """Global sorted dictionary over all partitions (built once;
-        concurrent scans serialize on the build and share the result)."""
+        """Global sorted dictionary over all partitions (built once per
+        registry entry; concurrent scans serialize on the build, and
+        sibling sources over the same files share the interned
+        instance)."""
+        from .. import columnar_registry
+
         with self._dict_lock:
             if colname in self._dicts:
                 return self._dicts[colname]
+            d = columnar_registry.REGISTRY.lookup(self._dict_key(colname))
+            if d is not None:
+                self._dicts[colname] = d
+                return d
             with phase("parse"):
                 if self._use_native():
                     self._build_native_dicts_locked([colname])
@@ -200,13 +234,16 @@ class DelimitedSource(TableSource):
                     df = self._read_pandas(f, self._column_names(), [idx])
                     # empty fields: "" is a utf8 VALUE (native-scanner
                     # convention), not NULL
-                    u = np.unique(
+                    u = np.unique(  # dict-ok: raw-value dict build
                         df[colname].fillna("").astype(str)
                         .to_numpy(dtype=object)
                     )
                     uniq = (u if uniq is None
-                            else np.unique(np.concatenate([uniq, u])))
-                d = Dictionary(uniq if uniq is not None else [])
+                            else np.unique(  # dict-ok: raw-value build
+                                np.concatenate([uniq, u])))
+                d = columnar_registry.intern(
+                    self._dict_key(colname),
+                    uniq if uniq is not None else [])
                 self._dicts[colname] = d
                 return d
 
@@ -254,11 +291,11 @@ class DelimitedSource(TableSource):
                       if self._schema.field(n).dtype.kind == "utf8"]
         with phase("parse", path=path, prepass="dicts"):
             self._build_native_dicts(utf8_names)
-        # hoist the fixed-width dictionary copies out of the chunk loop:
+        # hoist the fixed-width dictionary views out of the chunk loop:
+        # values_str() declines to cache views past its size cap, and
         # re-materializing a big dictionary per 256MB range would churn
         # exactly the memory this path exists to bound
-        dict_keys = {n: self._dicts[n].values.astype(str)
-                     for n in utf8_names}
+        dict_keys = {n: self._dicts[n].values_str() for n in utf8_names}
         off = 0
         emitted = False
         while off < size:
@@ -273,8 +310,10 @@ class DelimitedSource(TableSource):
                 dicts: Dict[str, Dictionary] = {}
                 for name in utf8_names:
                     d = self._dicts[name]
-                    remap = np.searchsorted(dict_keys[name],
-                                            fdicts[name].astype(str))
+                    remap = np.searchsorted(  # dict-ok: hoisted encode
+                        dict_keys[name],
+                        np.asarray(fdicts[name]).astype(str)
+                    ).astype(np.int32)
                     arrays[name] = remap[arrays[name]].astype(np.int32)
                     dicts[name] = d
             yield from self._emit_batches(sub_schema, n, arrays, dicts,
@@ -302,22 +341,24 @@ class DelimitedSource(TableSource):
                 continue
             fvals = fdicts[name]
             if len(self._files) == 1:
+                from .. import columnar_registry
+
                 with self._dict_lock:  # one adopted instance per column
                     if name not in self._dicts:
-                        self._dicts[name] = Dictionary(fvals)
+                        self._dicts[name] = columnar_registry.intern(
+                            self._dict_key(name), fvals)
                     d = self._dicts[name]
-                # same file scanned twice must yield the same dict; remap
-                # defensively if the cached dict came from elsewhere
+                # same file scanned twice must yield the same dict (and
+                # interning may have returned a superset version); remap
+                # when the file's values are not the dictionary verbatim
                 if len(d) != len(fvals) or not np.array_equal(
-                    d.values.astype(str), fvals.astype(str)
+                    d.values_str(), np.asarray(fvals).astype(str)
                 ):
-                    remap = np.searchsorted(
-                        d.values.astype(str), fvals.astype(str)
-                    )
+                    remap = d.positions_of(fvals)
                     arrays[name] = remap[arrays[name]].astype(np.int32)
             else:
                 d = self._dictionary_for(name)
-                remap = np.searchsorted(d.values.astype(str), fvals.astype(str))
+                remap = d.positions_of(fvals)
                 arrays[name] = remap[arrays[name]].astype(np.int32)
             dicts[name] = d
         return n, arrays, dicts, valids
@@ -344,8 +385,7 @@ class DelimitedSource(TableSource):
             if field.dtype.kind == "utf8":
                 d = self._dictionary_for(name)
                 vals = raw.fillna("").astype(str).to_numpy(dtype=object)
-                codes = np.searchsorted(d.values.astype(str), vals.astype(str))
-                arrays[name] = codes.astype(np.int32)
+                arrays[name] = d.positions_of(vals)
                 dicts[name] = d
             elif field.dtype.kind == "decimal":
                 from ..columnar import decimal_to_scaled

@@ -344,8 +344,7 @@ class Evaluator:
                 out = jnp.logical_not(out)
             return Evaluated(out, Boolean, validity)
         # ordering against a sorted dictionary: code-space boundary compare
-        lo = int(np.searchsorted(d.values.astype(str), s, side="left"))
-        hi = int(np.searchsorted(d.values.astype(str), s, side="right"))
+        lo, hi = d.code_range(s)
         if op == "<":
             out = codes < lo
         elif op == "<=":

@@ -91,6 +91,12 @@ KNOBS: Dict[str, tuple] = {
                                            "within one file"),
     "BALLISTA_SCAN_CHUNK_BYTES": ("1073741824", "text scan chunk size"),
     # kernels / execution
+    "BALLISTA_DICT_REGISTRY": ("on", "process-wide dictionary registry: "
+                                     "interned string dictionaries, "
+                                     "cached integer remaps, epoch-keyed "
+                                     "AOT artifacts (off = legacy "
+                                     "object-array unify/remap; "
+                                     "docs/strings.md)"),
     "BALLISTA_PALLAS": ("off", "force the Pallas dense-aggregation kernel "
                                "(off/on/interpret)"),
     "BALLISTA_JOIN_SWAP": ("on", "planner may swap join build/probe sides "

@@ -324,10 +324,6 @@ def concat_batches(schema: Schema, batches: List[ColumnBatch]) -> ColumnBatch:
         raise ExecutionError("concat of zero batches")
     if len(batches) == 1:
         return batches[0]
-    import numpy as np
-
-    from ..columnar import Dictionary
-
     cols: List[Column] = []
     for i, f in enumerate(schema.fields):
         values_list = [b.columns[i].values for b in batches]
@@ -336,25 +332,24 @@ def concat_batches(schema: Schema, batches: List[ColumnBatch]) -> ColumnBatch:
         if dict_ is not None and any(
             d is not None and d is not dict_ for d in dicts
         ):
-            # unify: sorted union + per-batch code remap
+            # unify through the dictionary registry: shared-entry
+            # dictionaries resolve to a no-op or a cached int32 remap
+            # (a device gather); unregistered dictionaries fall back
+            # to the legacy sorted union inside the registry module
             from ..observability import trace_span
+            from .. import columnar_registry
 
             with trace_span("host.dictionary", site="concat.unify",
                             column=f.name, n_dicts=len(dicts)):
-                union = np.unique(np.concatenate(
-                    [np.asarray(d.values, dtype=object) for d in dicts
-                     if d is not None]
-                ))
-                union_str = union.astype(str)
-                dict_ = Dictionary(union)
+                target, remaps = columnar_registry.unify(dicts)
+                dict_ = target
                 remapped = []
-                for d, v in zip(dicts, values_list):
-                    if d is None or len(d) == 0:
+                for v, remap in zip(values_list, remaps):
+                    if remap is None:
                         remapped.append(v)
                         continue
-                    remap = np.searchsorted(union_str, d.values.astype(str))
                     remapped.append(
-                        jnp.take(jnp.asarray(remap.astype(np.int32)),
+                        jnp.take(jnp.asarray(remap),
                                  v.astype(jnp.int32), mode="clip")
                     )
                 values_list = remapped

@@ -604,20 +604,22 @@ class JoinExec(PhysicalPlan):
             cached = self._remap_cache.get(bcol)
             if cached is None or cached[0] is not bd or cached[1] is not pd_:
                 from ..observability import trace_span
+                from .. import columnar_registry
 
                 with trace_span("host.dictionary", site="join.remap",
                                 column=bcol, n_build=len(bd),
                                 n_probe=len(pd_)):
-                    bvals = bd.values.astype(str)
-                    pvals = pd_.values.astype(str)
-                    if len(bvals):
-                        idx = np.searchsorted(bvals, pvals)
-                        idx_c = np.minimum(idx, len(bvals) - 1)
-                        ok = bvals[idx_c] == pvals
-                        remap = np.where(ok, idx_c, -1).astype(np.int64)
-                    else:
-                        remap = np.full(max(len(pvals), 1), -1, np.int64)
-                    cached = (bd, pd_, jnp.asarray(remap))
+                    # registry: same-entry pairs compose integer step
+                    # remaps; cross-entry pairs build ONE cached sorted
+                    # search per (content, content) pair process-wide
+                    # (the legacy behavior rebuilt it per join instance
+                    # per dictionary pair)
+                    remap = columnar_registry.remap_between(pd_, bd)
+                    if remap is None:  # identical coding: identity map
+                        remap = np.arange(len(pd_), dtype=np.int64) \
+                            if len(pd_) else np.full(1, -1, np.int64)
+                    cached = (bd, pd_,
+                              jnp.asarray(remap.astype(np.int64)))
                 self._remap_cache[bcol] = cached
             out.append(cached[2])
         return tuple(out)

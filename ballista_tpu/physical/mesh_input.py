@@ -74,21 +74,18 @@ def _union_dicts(schema: Schema, batches: List[ColumnBatch]):
             dicts.append(d0)
             continue
         from ..observability import trace_span
+        from .. import columnar_registry
 
         with trace_span("host.dictionary", site="mesh.union",
                         column=schema.fields[i].name, n_dicts=len(ds)):
-            union = np.unique(np.concatenate(
-                [np.asarray(d.values, dtype=object)
-                 for d in ds if d is not None]
-            ))
-            union_str = union.astype(str)
-            ud = Dictionary(union)
-            for bi, d in enumerate(ds):
-                if d is None or len(d) == 0:
-                    continue
-                remaps[bi][i] = np.searchsorted(
-                    union_str, d.values.astype(str)
-                ).astype(np.int32)
+            # registry: shared-entry dictionaries resolve to the max
+            # version + cached int32 remaps (the device gather in
+            # _apply_remaps); unregistered fall back to the legacy
+            # sorted union inside the registry module
+            ud, rms = columnar_registry.unify(ds)
+            for bi, r in enumerate(rms):
+                if r is not None:
+                    remaps[bi][i] = r
         dicts.append(ud)
     return dicts, remaps
 

@@ -601,18 +601,16 @@ def _run_bench(args) -> None:
     snapshot("q3_q18_done")
 
     # -- q16 (COUNT(DISTINCT) query; the fused distinct-count kernel's
-    # pinned workload — ISSUE 6 targets >=2x its r05 warm time) --------------
-    q16_sql = open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "benchmarks", "tpch", "queries",
-                                "q16.sql")).read()
-    try:
-        df16 = ctx.sql(q16_sql)
-        q16_first = timed(df16)  # load + compile
-        q16_warm = min(timed(df16) for _ in range(max(args.runs - 1, 1)))
-        result["q16_first_seconds"] = round(q16_first, 4)
-        result["q16_warm_seconds"] = round(q16_warm, 4)
-    except Exception as e:  # noqa: BLE001 - q1 metric still reports
-        print(f"# q16 failed: {e}", file=sys.stderr)
+    # pinned workload — ISSUE 6 targets >=2x its r05 warm time). It is
+    # also the bench's string-heavy JOIN query (partsupp joins part
+    # under brand/type string predicates, groups by three string
+    # columns, and anti-joins a comment LIKE subquery), so per ISSUE 11
+    # / ROADMAP item 1 its first run emits the q16_-prefixed profiler
+    # lane fields — q16_host_dictionary_seconds pins the lane the
+    # dictionary registry exists to kill, gated between rounds by
+    # dev/check_bench_regress.py.
+    profiled_query(ctx, "q16", open(os.path.join(qdir, "q16.sql")).read(),
+                   args.runs, result, timed, lane_prefix="q16_")
     snapshot("q16_done")
 
     # -- per-stage decomposition + AOT kernel + MFU estimate ----------------

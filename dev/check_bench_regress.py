@@ -61,6 +61,11 @@ METRICS: Dict[str, Tuple[str, float]] = {
     "q18_device_blocked_seconds": ("lower", 0.45),
     "q18_host_dictionary_seconds": ("lower", 0.45),
     "q18_compile_trace_lower_seconds": ("lower", 0.45),
+    # PR 11 (dictionary registry): q16 is the string-heavy join query
+    # pinning the host_dictionary lane — it may never silently regrow
+    "q16_device_blocked_seconds": ("lower", 0.45),
+    "q16_host_dictionary_seconds": ("lower", 0.45),
+    "q16_compile_trace_lower_seconds": ("lower", 0.45),
     # resource envelope
     "peak_rss_mb": ("lower", 0.30),
     # live progress plane (PR 10): on_progress callbacks delivered
