@@ -9,6 +9,22 @@ the native C++ server in ballista_tpu/native/shuffle_server.cpp):
   response: u8 status (0=ok, 1=error) | u64_be length | payload
             payload = Arrow IPC file bytes (ok) or utf-8 error message
 
+Streaming extension (docs/shuffle.md): a request whose Action carries
+``stream_window > 0`` asks for a flow-controlled chunk stream instead
+of one whole-partition payload. A server that understands it (the
+Python server here) answers with status byte 2 followed by frames
+
+  u32_be n | n chunk bytes        (one bounded chunk)
+  u32_be 0                        (clean end of stream)
+  u32_be 0xFFFFFFFF | u32_be len | message   (mid-stream error)
+
+and suspends once more than ``stream_window`` bytes are in flight
+unacknowledged — the reader acks each consumed chunk with a bare
+``u32_be n``. The native C++ daemon predates the field, skips it
+(protobuf unknown-field semantics) and answers with the legacy framing;
+clients consume that body in bounded chunk reads, so memory stays
+bounded on either server.
+
 Python server threads serve from the executor work_dir; the C++ server is a
 drop-in replacement on the same protocol.
 """
@@ -20,10 +36,29 @@ import socket
 import socketserver
 import struct
 import threading
-from typing import Optional
+from collections import deque
+from typing import Iterator, Optional
 
 from ..errors import IoError
 from ..proto import ballista_pb2 as pb
+
+# job ids whose in-flight chunk streams must abort (the executor marks
+# them on a CancelJob broadcast): the server-side stream writer checks
+# per chunk, so cancellation propagates INTO mid-flight transfers
+# instead of waiting for the file to finish streaming
+_cancelled_lock = threading.Lock()
+_cancelled_jobs: deque = deque(maxlen=256)
+
+
+def mark_job_cancelled(job_id: str) -> None:
+    with _cancelled_lock:
+        if job_id not in _cancelled_jobs:
+            _cancelled_jobs.append(job_id)
+
+
+def job_stream_cancelled(job_id: str) -> bool:
+    with _cancelled_lock:
+        return job_id in _cancelled_jobs
 
 
 def path_component_ok(s: str) -> bool:
@@ -74,9 +109,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def fetch_partition_bytes(host: str, port: int, job_id: str, stage_id: int,
-                          partition_id: int, timeout: float = 60.0,
-                          shuffle_output: "int | None" = None) -> bytes:
+def _fetch_action(job_id: str, stage_id: int, partition_id: int,
+                  shuffle_output: "int | None") -> pb.Action:
     action = pb.Action()
     if shuffle_output is not None:
         action.fetch_shuffle.producer.job_id = job_id
@@ -87,6 +121,13 @@ def fetch_partition_bytes(host: str, port: int, job_id: str, stage_id: int,
         action.fetch_partition.job_id = job_id
         action.fetch_partition.stage_id = stage_id
         action.fetch_partition.partition_id = partition_id
+    return action
+
+
+def fetch_partition_bytes(host: str, port: int, job_id: str, stage_id: int,
+                          partition_id: int, timeout: float = 60.0,
+                          shuffle_output: "int | None" = None) -> bytes:
+    action = _fetch_action(job_id, stage_id, partition_id, shuffle_output)
     payload = action.SerializeToString()
     with socket.create_connection((host, port), timeout=timeout) as sock:
         sock.sendall(struct.pack(">I", len(payload)) + payload)
@@ -96,6 +137,81 @@ def fetch_partition_bytes(host: str, port: int, job_id: str, stage_id: int,
     if status != 0:
         raise IoError(f"fetch failed: {body.decode(errors='replace')}")
     return body
+
+
+_STREAM_ERROR_FRAME = 0xFFFFFFFF
+
+
+def fetch_partition_chunks(host: str, port: int, job_id: str,
+                           stage_id: int, partition_id: int,
+                           timeout: float = 60.0,
+                           shuffle_output: "int | None" = None,
+                           window_bytes: "int | None" = None,
+                           chunk_bytes: "int | None" = None,
+                           ) -> Iterator[bytes]:
+    """Streaming fetch: yields the partition's bytes in bounded chunks.
+
+    Negotiates the chunk-stream framing via ``Action.stream_window``; a
+    legacy peer (the native C++ daemon) ignores the field and answers
+    with the whole-payload framing, which is then consumed in
+    ``chunk_bytes`` reads — either way no whole-partition buffer ever
+    exists on this side, and the caller controls the pace (it pulls the
+    generator), which IS the flow control: acks are sent only after the
+    previous chunk was consumed, so a slow consumer idles the wire at
+    ``window_bytes`` in flight, not at the partition size."""
+    from .spill import shuffle_chunk_bytes, stream_window_bytes
+
+    window = int(window_bytes or stream_window_bytes())
+    piece = int(chunk_bytes or shuffle_chunk_bytes())
+    action = _fetch_action(job_id, stage_id, partition_id, shuffle_output)
+    action.stream_window = window
+    action.stream_chunk = piece
+    payload = action.SerializeToString()
+    sock = socket.create_connection((host, port), timeout=timeout)
+    try:
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
+        status = _recv_exact(sock, 1)[0]
+        if status == 1:
+            (length,) = struct.unpack(">Q", _recv_exact(sock, 8))
+            body = _recv_exact(sock, length)
+            raise IoError(f"fetch failed: {body.decode(errors='replace')}")
+        if status == 0:
+            # legacy whole-payload framing (native server): the length
+            # is known up front; consume the body in bounded reads
+            (length,) = struct.unpack(">Q", _recv_exact(sock, 8))
+            remaining = length
+            while remaining > 0:
+                chunk = _recv_exact(sock, min(piece, remaining))
+                remaining -= len(chunk)
+                yield chunk
+            return
+        if status != 2:
+            raise IoError(f"bad data-plane status byte {status}")
+        while True:
+            (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+            if n == 0:
+                return
+            if n == _STREAM_ERROR_FRAME:
+                (mlen,) = struct.unpack(">I", _recv_exact(sock, 4))
+                msg = _recv_exact(sock, mlen)
+                raise IoError(
+                    f"stream failed: {msg.decode(errors='replace')}")
+            chunk = _recv_exact(sock, n)
+            yield chunk
+            # ack AFTER the consumer resumed us: in-flight unacked
+            # bytes measure what the reader has genuinely not absorbed.
+            # A send failure is NOT a stream failure — a server that
+            # already sent its end marker closes without draining the
+            # trailing acks; the next frame read is the source of truth
+            try:
+                sock.sendall(struct.pack(">I", n))
+            except OSError:
+                pass
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -140,6 +256,11 @@ class _Handler(socketserver.BaseRequestHandler):
                 raise IoError("bad job id")
             if not os.path.exists(path):
                 raise IoError(f"no such partition: {path}")
+            if action.stream_window > 0 and self.server.stream_serve:
+                self._serve_stream(path, job_id,
+                                   int(action.stream_window),
+                                   int(action.stream_chunk))
+                return
             with open(path, "rb") as fh:
                 body = fh.read()
             self.request.sendall(struct.pack(">BQ", 0, len(body)))
@@ -151,10 +272,69 @@ class _Handler(socketserver.BaseRequestHandler):
             except OSError:
                 pass
 
+    def _serve_stream(self, path: str, job_id: str, window: int,
+                      req_chunk: int = 0) -> None:
+        """Flow-controlled chunk stream (status byte 2; framing in the
+        module docstring). The writer suspends on the peer's acks once
+        ``window`` bytes are unacknowledged, checks the cancelled-job
+        registry per chunk (a CancelJob aborts mid-flight transfers, not
+        just future ones) and exposes the ``dataplane.flow`` fault point
+        (drop = close mid-stream like a crashed peer; fail = tagged
+        error frame). Transport errors just end the handler — the peer
+        sees a dead connection and takes its retry/recovery path."""
+        from ..testing.faults import fault_point
+        from .spill import shuffle_chunk_bytes
+
+        sock = self.request
+        # the reader's requested frame size, capped by this server's own
+        # chunk bound (a peer must not force huge frames on us)
+        piece = shuffle_chunk_bytes()
+        if req_chunk > 0:
+            piece = min(piece, req_chunk)
+        sock.settimeout(60.0)  # ack reads must not wedge a dead peer
+        sock.sendall(b"\x02")
+        unacked = 0
+        try:
+            with open(path, "rb") as fh:
+                while True:
+                    if job_stream_cancelled(job_id):
+                        self._stream_error(f"job {job_id} cancelled")
+                        return
+                    # "fail" raises out to the error frame below;
+                    # "drop" = close mid-stream like a crashed peer
+                    if fault_point("dataplane.flow", path=path) == "drop":
+                        return
+                    chunk = fh.read(piece)
+                    if not chunk:
+                        break
+                    while unacked + len(chunk) > window and unacked > 0:
+                        (acked,) = struct.unpack(
+                            ">I", _recv_exact(sock, 4))
+                        unacked -= acked
+                    sock.sendall(struct.pack(">I", len(chunk)) + chunk)
+                    unacked += len(chunk)
+            sock.sendall(struct.pack(">I", 0))
+        except (OSError, IoError):
+            return  # peer vanished mid-stream; nothing to report to
+        except Exception as e:  # noqa: BLE001 - report mid-stream
+            self._stream_error(f"{type(e).__name__}: {e}")
+
+    def _stream_error(self, msg: str) -> None:
+        data = msg.encode()
+        try:
+            self.request.sendall(
+                struct.pack(">II", _STREAM_ERROR_FRAME, len(data)) + data)
+        except OSError:
+            pass
+
 
 class DataPlaneServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
+
+    # tests flip this off to pin the legacy whole-payload framing (the
+    # same path a native C++ peer answers with)
+    stream_serve = True
 
     def __init__(self, host: str, port: int, work_dir: str):
         super().__init__((host, port), _Handler)

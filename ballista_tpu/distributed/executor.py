@@ -183,7 +183,9 @@ class Executor:
         exported on the local /metrics."""
         from ..ingest import pool_queue_depth
         from ..observability import memory as obs_memory
+        from . import spill as _spill
 
+        gov = _spill.governor().stats()
         return {
             "rss_bytes": obs_memory.rss_bytes(),
             "device_bytes": obs_memory.device_bytes(),
@@ -194,6 +196,11 @@ class Executor:
             "inflight_tasks": max(0, self._inflight),
             "ingest_pool_depth": pool_queue_depth(),
             "peak_host_bytes": obs_memory.peak_host_bytes(),
+            # shuffle memory governor: in-flight buffer bytes + bytes
+            # spilled to disk, so the scheduler sees memory pressure
+            # per executor
+            "shuffle_inflight_bytes": gov["inflight_bytes"],
+            "spill_bytes_total": gov["spilled_bytes_total"],
         }
 
     def _metric_samples(self):
@@ -357,6 +364,10 @@ class Executor:
             int(g["ingest_pool_depth"])
         params.metadata.resources.peak_host_bytes = \
             int(g["peak_host_bytes"])
+        params.metadata.resources.shuffle_inflight_bytes = \
+            int(g["shuffle_inflight_bytes"])
+        params.metadata.resources.spill_bytes_total = \
+            int(g["spill_bytes_total"])
         with self._status_lock:
             pending = list(self._pending_status)
             self._pending_status.clear()
@@ -460,6 +471,11 @@ class Executor:
         if fired:
             log.info("job %s cancelled; aborting %d running task(s)",
                      job_id, fired)
+        # server-side stream abort: chunk streams this executor is
+        # serving for the job terminate at their next chunk boundary
+        from .dataplane import mark_job_cancelled
+
+        mark_job_cancelled(job_id)
         if job_id not in self._cleaned_jobs:
             self._cleaned_jobs.append(job_id)
             self._cleanup_job_outputs(job_id)
@@ -634,10 +650,15 @@ class Executor:
 
     def execute_partition(self, pid: PartitionId, plan,
                           shuffle=None) -> dict:
-        """Run one stage partition and materialize its output
-        (reference: flight_service.rs:89-192). With ``shuffle``
-        ((hash_exprs|None, n_out)) the output is hash/round-robin split
-        into one shuffle-q file per consumer partition."""
+        """Run one stage partition and STREAM its output to disk
+        (reference: flight_service.rs:89-192). Batches are written as
+        they are produced — bounded Arrow-IPC chunks through
+        ``ipc.PartitionWriter`` — so the executor never holds a whole
+        partition's output alongside its conversion buffers; the cancel
+        token is checked at every batch pull AND every chunk write.
+        With ``shuffle`` ((hash_exprs|None, n_out)) the output is
+        hash/round-robin split into one shuffle-q file per consumer
+        partition."""
         from ..io import ipc
         from ..ingest import cancel_plan, prime_plan
 
@@ -647,40 +668,41 @@ class Executor:
         # a merged join stage) parses them concurrently; primed handles
         # an aborted task leaves behind are cancelled, never leaked
         prime_plan(plan, partitions=[pid.partition_id])
-        try:
-            batches = []
-            for batch in plan.execute(pid.partition_id):
-                # cooperative cancellation at the batch boundary: a
-                # fired token (job cancel, drain) stops the pull here;
-                # cancel_plan below unparks the ingest producers
-                check_cancel()
-                batches.append(batch)
-        finally:
-            # handles the plan never consumed (limit short-circuits,
-            # failures) must not leave producers parked on full queues
-            cancel_plan(plan)
         if shuffle is not None:
-            stats = self._write_shuffled(pid, plan, batches, shuffle, t0)
+            try:
+                stats = self._write_shuffled(pid, plan, shuffle, t0)
+            finally:
+                # handles the plan never consumed (failures) must not
+                # leave producers parked on full queues
+                cancel_plan(plan)
             stats["task_metrics"] = self._harvest_metrics(
                 plan, time.time() - t0, stats, shuffled=True)
             return stats
         path = partition_path(self.config.work_dir, pid.job_id, pid.stage_id,
                               pid.partition_id)
-        tw = time.time()
-        with trace_span("dataplane.write", path=path):
-            if batches:
-                stats = ipc.write_partition(path, batches)
-            else:
-                # empty partition: write an empty file with the plan schema
-                from ..columnar import empty_batch
-
-                stats = ipc.write_partition(
-                    path, [empty_batch(plan.output_schema())])
+        writer = ipc.PartitionWriter(path, schema=plan.output_schema(),
+                                     compute_column_stats=True)
+        try:
+            with trace_span("dataplane.write", path=path):
+                for batch in plan.execute(pid.partition_id):
+                    # cooperative cancellation at the batch boundary: a
+                    # fired token (job cancel, drain) stops the pull
+                    # here; cancel_plan below unparks ingest producers
+                    check_cancel()
+                    writer.write_batch(batch)
+                # empty partition: close() synthesizes one empty batch
+                # with the plan schema
+                stats = writer.close()
+        except BaseException:
+            writer.abort()
+            raise
+        finally:
+            cancel_plan(plan)
         log.info("executed %s in %.1fs (%d rows)", pid.key(),
                  time.time() - t0, stats["num_rows"])
         out = {**stats, "path": path}
         out["task_metrics"] = self._harvest_metrics(
-            plan, time.time() - t0, stats, write_secs=time.time() - tw)
+            plan, time.time() - t0, stats, write_secs=writer.write_seconds)
         return out
 
     def _harvest_metrics(self, plan, elapsed_total: float, stats: dict,
@@ -704,8 +726,15 @@ class Executor:
         ops.append(write_row)
         return {"operators": ops, "elapsed_total": elapsed_total}
 
-    def _write_shuffled(self, pid: PartitionId, plan, batches, shuffle,
+    def _write_shuffled(self, pid: PartitionId, plan, shuffle,
                         t0: float) -> dict:
+        """Streaming n_out-way shuffle write: every produced batch is
+        hash-split and its slices appended to the per-consumer-partition
+        stream writers IMMEDIATELY, so neither the stage output nor its
+        Arrow conversion buffers ever accumulate — host memory peaks at
+        one bounded chunk per writer. Record-batch structure matches the
+        old materialize-then-write path (one batch per (input batch, q),
+        plus chunk splits), keeping results byte-identical."""
         import jax.numpy as jnp
 
         from ..io import ipc
@@ -716,34 +745,40 @@ class Executor:
         hash_exprs, n_out = shuffle
         schema = plan.output_schema()
         ev = Evaluator(schema)
-        if not batches:
-            from ..columnar import empty_batch
-
-            batches = [empty_batch(schema)]
-        totals = {"num_rows": 0, "num_batches": 0, "num_bytes": 0}
-        masked = [[] for _ in range(n_out)]
-        offset = 0
-        for b in batches:
-            pids = compute_partition_ids(b, hash_exprs, n_out, offset, ev)
-            for q in range(n_out):
-                masked[q].append(
-                    b.with_selection(jnp.logical_and(b.selection, pids == q))
-                )
-            offset += b.num_rows_host()
+        writers = []
         base = None
-        # per-output-partition byte histogram: the signal adaptive
-        # re-planning coalesces/splits the consuming stage on
-        qbytes = []
-        with trace_span("dataplane.write", task=pid.key(), fan_out=n_out):
-            for q in range(n_out):
-                path = shuffle_path(self.config.work_dir, pid.job_id,
-                                    pid.stage_id, pid.partition_id, q)
-                base = path
-                st = ipc.write_partition(path, masked[q],
-                                         compute_column_stats=False)
-                qbytes.append(int(st["num_bytes"]))
-                for k in totals:
-                    totals[k] += st[k]
+        for q in range(n_out):
+            path = shuffle_path(self.config.work_dir, pid.job_id,
+                                pid.stage_id, pid.partition_id, q)
+            base = path
+            writers.append(ipc.PartitionWriter(path, schema=schema))
+        totals = {"num_rows": 0, "num_batches": 0, "num_bytes": 0}
+        offset = 0
+        try:
+            with trace_span("dataplane.write", task=pid.key(),
+                            fan_out=n_out):
+                for b in plan.execute(pid.partition_id):
+                    check_cancel()
+                    pids = compute_partition_ids(b, hash_exprs, n_out,
+                                                 offset, ev)
+                    for q in range(n_out):
+                        writers[q].write_batch(b.with_selection(
+                            jnp.logical_and(b.selection, pids == q)))
+                    offset += b.num_rows_host()
+                # per-output-partition byte histogram: the signal
+                # adaptive re-planning coalesces/splits the consuming
+                # stage on. Writers that saw no batches (or no rows)
+                # close with one empty schema-bearing batch.
+                qbytes = []
+                for q in range(n_out):
+                    st = writers[q].close()
+                    qbytes.append(int(st["num_bytes"]))
+                    for k in totals:
+                        totals[k] += st[k]
+        except BaseException:
+            for w in writers:
+                w.abort()
+            raise
         totals["shuffle_partition_bytes"] = qbytes
         log.info("executed %s (shuffle x%d) in %.1fs (%d rows)", pid.key(),
                  n_out, time.time() - t0, totals["num_rows"])

@@ -134,9 +134,12 @@ class BallistaFlightServer(paflight.FlightServerBase if paflight else object):
         else:
             path = shuffle_path(self._work_dir, job_id, stage_id,
                                 partition_id, shuffle_output)
-        # partitions are materialized AS Arrow IPC files (io/ipc.py), so
-        # they stream verbatim — dictionary encoding preserved
-        reader = pa.ipc.open_file(pa.memory_map(path, "r"))
+        # partitions are materialized AS Arrow IPC (io/ipc.py) — stream
+        # format from the chunked writers, legacy file format from older
+        # data — so they stream verbatim, dictionary encoding preserved
+        from ..io import ipc as _ipc
+
+        reader = _ipc.open_arrow_reader(path)
         return paflight.RecordBatchStream(reader.read_all())
 
     # -- discovery RPCs (minimal but spec-conformant) -----------------------

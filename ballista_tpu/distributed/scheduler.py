@@ -379,6 +379,8 @@ class SchedulerService:
                 "inflight_tasks": res.get("inflight_tasks"),
                 "ingest_pool_depth": res.get("ingest_pool_depth"),
                 "peak_host_bytes": res.get("peak_host_bytes"),
+                "shuffle_inflight_bytes": res.get("shuffle_inflight_bytes"),
+                "spill_bytes_total": res.get("spill_bytes_total"),
                 "heartbeat_age_seconds": round(age, 3)
                 if age is not None else None,
                 "stale": int(age is None or age > thr),
@@ -715,6 +717,8 @@ class SchedulerService:
                 "inflight_tasks": int(r.inflight_tasks),
                 "ingest_pool_depth": int(r.ingest_pool_depth),
                 "peak_host_bytes": int(r.peak_host_bytes),
+                "shuffle_inflight_bytes": int(r.shuffle_inflight_bytes),
+                "spill_bytes_total": int(r.spill_bytes_total),
             }
         meta = ExecutorMeta(
             id=request.metadata.id,

@@ -14,13 +14,22 @@ Rows are COMPACTED to the live selection before writing, so shuffle files
 carry no padding. Readers get physical arrays back plus per-file
 dictionaries; ``unify_dictionaries`` merges multiple producers' codes into
 one table-wide dictionary via searchsorted remapping (no per-row decode).
+
+Streaming layout (docs/shuffle.md): writers emit Arrow IPC **stream**
+format with record batches bounded to ``BALLISTA_SHUFFLE_CHUNK_BYTES``
+(:class:`PartitionWriter`), so the data plane can serve and readers can
+decode partitions chunk-by-chunk without whole-partition buffering.
+Readers sniff the format (``ARROW1`` magic = legacy file format) so
+both layouts stay readable.
 """
 
 from __future__ import annotations
 
+import io as _io
 import os
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -28,6 +37,8 @@ from ..columnar import Column, ColumnBatch, Dictionary
 from ..compile import bucket_capacity
 from ..datatypes import Field, Schema
 from ..errors import IoError
+
+ARROW_FILE_MAGIC = b"ARROW1"
 
 
 _POOL_CHECKED = False
@@ -112,86 +123,211 @@ def batch_to_arrow(batch: ColumnBatch):
     return pa.record_batch(arrays, schema=pa.schema(fields))
 
 
+def _iter_chunked(rb, chunk_bytes: int):
+    """Split one Arrow record batch into row slices of at most
+    ``chunk_bytes`` (estimated from the batch's mean bytes/row). Slices
+    share the parent's buffers; the IPC writer truncates them to the
+    slice window on write, so the file carries bounded record batches."""
+    n = rb.num_rows
+    if n == 0 or rb.nbytes <= chunk_bytes:
+        yield rb
+        return
+    rows = max(int(chunk_bytes / max(rb.nbytes / n, 1e-9)), 1)
+    for lo in range(0, n, rows):
+        yield rb.slice(lo, min(rows, n - lo))
+
+
+class _ColumnStatsAcc:
+    """Incremental per-column {null_count, distinct_count, min, max}
+    accumulator — the streaming replacement for the old whole-table
+    stats pass (reference declares ColumnStats but never fills it,
+    ballista.proto:478-485). min/max merge per record batch via
+    pyarrow's vectorized kernels; distinct_count stays exact for
+    dictionary columns by unioning the OBSERVED code sets (codes map
+    1:1 to values within one dictionary), and degrades to -1 when a
+    stream carries replacement dictionaries."""
+
+    def __init__(self):
+        self._cols: Optional[Dict[str, dict]] = None
+
+    def update(self, rb) -> None:
+        pa = _arrow()
+        import pyarrow.compute as pc
+
+        if self._cols is None:
+            self._cols = {
+                name: {"null": 0, "min": None, "max": None,
+                       "codes": set(), "first_dict": None, "multi": False}
+                for name in rb.schema.names
+            }
+        for i, name in enumerate(rb.schema.names):
+            st = self._cols[name]
+            col = rb.column(i)
+            st["null"] += int(col.null_count)
+            try:
+                typ = col.type
+                if pa.types.is_dictionary(typ):
+                    if st["first_dict"] is None:
+                        st["first_dict"] = col.dictionary
+                    elif not st["multi"] and not (
+                            col.dictionary is st["first_dict"]
+                            or col.dictionary.equals(st["first_dict"])):
+                        st["multi"] = True
+                    if not st["multi"]:
+                        st["codes"].update(
+                            pc.unique(col.indices.drop_null()).to_pylist())
+                    mm = pc.min_max(col.cast(typ.value_type))
+                else:
+                    st["codes"] = None
+                    mm = pc.min_max(col)
+                mn, mx = mm["min"].as_py(), mm["max"].as_py()
+                if mn is not None:
+                    mn, mx = _norm_stat(mn), _norm_stat(mx)
+                    st["min"] = mn if st["min"] is None else min(st["min"], mn)
+                    st["max"] = mx if st["max"] is None else max(st["max"], mx)
+            except Exception:  # noqa: BLE001 - stats stay partial
+                pass
+
+    def rows(self) -> List[Dict]:
+        out: List[Dict] = []
+        for name, st in (self._cols or {}).items():
+            entry: Dict = {"name": name, "null_count": st["null"],
+                           "distinct_count": -1}
+            if st["codes"] is not None and not st["multi"]:
+                entry["distinct_count"] = len(st["codes"])
+            if st["min"] is not None:
+                entry["min"] = st["min"]
+                entry["max"] = st["max"]
+            out.append(entry)
+        return out
+
+
+class PartitionWriter:
+    """Incremental Arrow-IPC STREAM writer for partition/shuffle files.
+
+    The streaming replacement for materialize-then-write: callers push
+    ColumnBatches as the plan produces them and each is converted,
+    sliced to at most ``BALLISTA_SHUFFLE_CHUNK_BYTES`` record batches
+    and written immediately — peak host memory is one chunk, not one
+    partition. Every chunk write checks the thread's cancel token (a
+    fired ``ctx.cancel()``/deadline aborts a multi-GB write mid-file)
+    and charges the shuffle memory governor transiently so the
+    in-flight gauge covers the write side too.
+
+    tmp+rename semantics are preserved: concurrent writers of the same
+    deterministic path (e.g. a speculative duplicate task) can never
+    leave a half-written file visible to a fetching consumer. ``close``
+    on a writer that saw no batches synthesizes one empty record batch
+    from ``schema`` (or raises when none was given), matching the old
+    empty-partition file shape."""
+
+    def __init__(self, path: str, schema: Optional[Schema] = None,
+                 chunk_bytes: Optional[int] = None,
+                 compute_column_stats: bool = False):
+        from ..distributed import spill as _spill
+
+        self._pa = _arrow()
+        self.path = path
+        self._schema = schema
+        self._chunk_bytes = chunk_bytes or _spill.shuffle_chunk_bytes()
+        self._stats = _ColumnStatsAcc() if compute_column_stats else None
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        self._sink = None
+        self._writer = None
+        self.num_rows = 0
+        self.num_batches = 0
+        self.write_seconds = 0.0
+        self._done = False
+
+    def write_batch(self, batch: ColumnBatch) -> None:
+        self.write_arrow(batch_to_arrow(batch))
+
+    def write_arrow(self, rb) -> None:
+        from ..distributed import spill as _spill
+        from ..lifecycle import check_cancel
+
+        gov = _spill.governor()
+        for piece in _iter_chunked(rb, self._chunk_bytes):
+            # chunk-level cancellation: deadlines/ctx.cancel() abort
+            # inside a large partition write, not after it
+            check_cancel()
+            nbytes = int(piece.nbytes)
+            gov.charge(nbytes)
+            try:
+                t0 = time.perf_counter()
+                if self._writer is None:
+                    self._sink = self._pa.OSFile(self._tmp, "wb")
+                    self._writer = self._pa.ipc.new_stream(
+                        self._sink, piece.schema)
+                self._writer.write_batch(piece)
+                self.write_seconds += time.perf_counter() - t0
+            finally:
+                gov.release(nbytes)
+            self.num_batches += 1
+            self.num_rows += piece.num_rows
+            if self._stats is not None:
+                self._stats.update(piece)
+
+    def close(self) -> Dict[str, int]:
+        if self._done:
+            raise IoError(f"partition writer already closed: {self.path}")
+        if self._writer is None:
+            if self._schema is None:
+                raise IoError("no batches to write")
+            from ..columnar import empty_batch
+
+            self.write_batch(empty_batch(self._schema))
+        try:
+            self._writer.close()
+            self._sink.close()
+            os.replace(self._tmp, self.path)
+        except BaseException:
+            self.abort()
+            raise
+        self._done = True
+        out = {
+            "num_rows": self.num_rows,
+            "num_batches": self.num_batches,
+            "num_bytes": os.path.getsize(self.path),
+        }
+        if self._stats is not None:
+            out["columns"] = self._stats.rows()
+        return out
+
+    def abort(self) -> None:
+        """Best-effort cleanup for failed writes: close handles, drop
+        the tmp file (idempotent)."""
+        self._done = True
+        for h in (self._writer, self._sink):
+            try:
+                if h is not None:
+                    h.close()
+            except Exception:  # noqa: BLE001 - already broken
+                pass
+        try:
+            os.unlink(self._tmp)
+        except OSError:
+            pass
+
+
 def write_partition(path: str, batches: List[ColumnBatch],
                     compute_column_stats: bool = True) -> Dict[str, int]:
-    """Write batches to an Arrow IPC file; returns PartitionStats dict
-    (reference: PartitionStats {num_rows, num_batches, num_bytes},
+    """Write batches to an Arrow IPC stream file; returns PartitionStats
+    dict (reference: PartitionStats {num_rows, num_batches, num_bytes},
     ballista.proto:478-485) plus per-column selectivity stats unless
     ``compute_column_stats`` is off (the n_out-way shuffle write path
     turns it off: per-file column stats there have no consumer and a
-    64-way shuffle would pay 64 stat passes per task)."""
-    pa = _arrow()
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    rbs = [batch_to_arrow(b) for b in batches]
-    if not rbs:
-        raise IoError("no batches to write")
-    schema = rbs[0].schema
-    num_rows = 0
-    # write to a tmp file in the same dir then rename: concurrent writers
-    # of the same deterministic path (e.g. a speculative duplicate task)
-    # can never leave a half-written file visible to a fetching consumer
-    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    64-way shuffle would pay 64 stat passes per task). Thin list-based
+    wrapper over :class:`PartitionWriter`."""
+    w = PartitionWriter(path, compute_column_stats=compute_column_stats)
     try:
-        with pa.OSFile(tmp, "wb") as sink:
-            with pa.ipc.new_file(sink, schema) as writer:
-                for rb in rbs:
-                    writer.write_batch(rb)
-                    num_rows += rb.num_rows
-        os.replace(tmp, path)
+        for b in batches:
+            w.write_batch(b)
+        return w.close()
     except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+        w.abort()
         raise
-    out = {
-        "num_rows": num_rows,
-        "num_batches": len(rbs),
-        "num_bytes": os.path.getsize(path),
-    }
-    if compute_column_stats:
-        out["columns"] = _column_stats(rbs)
-    return out
-
-
-def _column_stats(rbs) -> List[Dict]:
-    """Per-column {name, null_count, distinct_count, min, max} over the
-    written record batches (reference declares ColumnStats but never
-    fills it, ballista.proto:478-485; computing at write time makes the
-    numbers available to the optimizer for selectivity). min/max use
-    pyarrow's vectorized kernels — cheap relative to the IPC write.
-    distinct_count is exact for dictionary columns (dict size), -1
-    otherwise."""
-    pa = _arrow()
-    import pyarrow.compute as pc
-
-    table = pa.Table.from_batches(rbs)
-    out: List[Dict] = []
-    for name in table.column_names:
-        col = table.column(name)
-        entry: Dict = {"name": name,
-                       "null_count": int(col.null_count),
-                       "distinct_count": -1}
-        try:
-            typ = col.type
-            if pa.types.is_dictionary(typ):
-                # stats over the decoded VALUES (string min/max +
-                # exact distinct over the data actually present)
-                decoded = col.cast(typ.value_type)
-                entry["distinct_count"] = int(
-                    pc.count_distinct(decoded).as_py())
-                mm = pc.min_max(decoded)
-                mn, mx = mm["min"].as_py(), mm["max"].as_py()
-            else:
-                mm = pc.min_max(col)
-                mn, mx = mm["min"].as_py(), mm["max"].as_py()
-            if mn is not None:
-                entry["min"] = _norm_stat(mn)
-                entry["max"] = _norm_stat(mx)
-        except Exception:  # noqa: BLE001 - stats stay partial
-            pass
-        out.append(entry)
-    return out
 
 
 def _norm_stat(v):
@@ -223,78 +359,231 @@ def decode_fixed_size_list(chunk) -> np.ndarray:
     return flat.reshape(len(chunk), width)
 
 
+class _ChunkStream(_io.RawIOBase):
+    """File-like adapter over an iterator of byte chunks — lets
+    pyarrow's stream reader pull wire/spill chunks on demand, so decode
+    consumes the transfer incrementally instead of requiring one
+    contiguous whole-partition buffer."""
+
+    def __init__(self, chunks: Iterable[bytes]):
+        self._it = iter(chunks)
+        self._buf = b""
+        self._eof = False
+
+    def readable(self) -> bool:
+        return True
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            parts = [self._buf] + list(self._it)
+            self._buf = b""
+            self._eof = True
+            return b"".join(parts)
+        while len(self._buf) < n and not self._eof:
+            try:
+                self._buf += next(self._it)
+            except StopIteration:
+                self._eof = True
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+
+def open_arrow_reader(source):
+    """Open an Arrow IPC source (path, bytes, or file-like) in either
+    layout: legacy random-access FILE format (``ARROW1`` magic) or the
+    streaming STREAM format the chunked shuffle writers emit. Returns a
+    pyarrow reader exposing ``schema`` / ``read_all()`` /
+    ``read_next_batch()``."""
+    pa = _arrow()
+    if isinstance(source, (str, os.PathLike)):
+        with open(source, "rb") as fh:
+            head = fh.read(len(ARROW_FILE_MAGIC))
+        src = pa.memory_map(str(source), "r")
+    elif isinstance(source, (bytes, bytearray, memoryview)):
+        head = bytes(source[:len(ARROW_FILE_MAGIC)])
+        src = pa.BufferReader(source)
+    else:  # file-like: stream format only (no seekable magic check)
+        return pa.ipc.open_stream(source)
+    if head == ARROW_FILE_MAGIC:
+        return pa.ipc.open_file(src)
+    return pa.ipc.open_stream(src)
+
+
 def read_partition_arrays(
     path_or_buf,
 ) -> Tuple[List[str], Dict[str, np.ndarray], Dict[str, np.ndarray],
            Dict[str, np.ndarray], Dict[str, Tuple[str, int]]]:
-    """Read an IPC file -> (names, arrays, null_masks, dictionaries, kinds).
+    """Read an IPC partition -> (names, arrays, null_masks, dictionaries,
+    kinds).
 
     arrays hold PHYSICAL values (codes for utf8); dictionaries map colname ->
     np object array for utf8 columns; kinds map colname -> (kind, scale).
+    Accepts both IPC layouts (see :func:`open_arrow_reader`); decode is
+    incremental per record batch, so a memory-mapped stream file never
+    materializes its wire bytes as one blob.
     """
+    return _decode_reader(open_arrow_reader(path_or_buf))
+
+
+def read_partition_arrays_from_chunks(chunks: Iterable[bytes]):
+    """Incremental variant of :func:`read_partition_arrays` fed by an
+    iterator of stream-format byte chunks (the flow-controlled data
+    plane fetch, or a ChunkBuffer replay spanning RAM + spill files).
+    Chunks are pulled — and can be released by the producer — as the
+    decoder advances; a truncated stream raises pyarrow's invalid-IPC
+    error, which shuffle readers tag into ShuffleFetchError."""
     pa = _arrow()
-    if isinstance(path_or_buf, (str, os.PathLike)):
-        reader = pa.ipc.open_file(pa.memory_map(str(path_or_buf), "r"))
-    else:
-        reader = pa.ipc.open_file(pa.BufferReader(path_or_buf))
-    table = reader.read_all().combine_chunks()
-    names = table.schema.names
+    return _decode_reader(pa.ipc.open_stream(_ChunkStream(chunks)))
+
+
+def _batch_iter(reader):
+    if hasattr(reader, "num_record_batches"):  # legacy FILE format
+        for i in range(reader.num_record_batches):
+            yield reader.get_batch(i)
+        return
+    while True:
+        try:
+            rb = reader.read_next_batch()
+        except StopIteration:
+            return
+        yield rb
+
+
+def _decode_reader(reader):
+    """Shared incremental decode core: accumulate per-record-batch
+    numpy pieces (checking the thread's cancel token at every batch
+    boundary) and concatenate once — peak host memory is the decoded
+    arrays plus ONE batch's wire window, never decoded + whole blob."""
+    pa = _arrow()
+    from ..lifecycle import check_cancel
+
+    schema = reader.schema
+    names = list(schema.names)
+    metas = [schema.field(i).metadata or {} for i in range(len(names))]
+    pieces: Dict[str, List[np.ndarray]] = {n: [] for n in names}
+    null_pieces: Dict[str, List[np.ndarray]] = {n: [] for n in names}
+    # utf8 columns: per-batch (codes, dictionary) with replacement
+    # detection — a stream is allowed to swap dictionaries mid-flight
+    dict_state: Dict[str, dict] = {}
+    n_batches = 0
+    for rb in _batch_iter(reader):
+        # chunk-level cancellation: ctx.cancel()/deadlines abort
+        # mid-partition decodes (local mmap reads included)
+        check_cancel()
+        n_batches += 1
+        for i, name in enumerate(names):
+            col = rb.column(i)
+            if pa.types.is_dictionary(col.type):
+                codes = col.indices.to_numpy(
+                    zero_copy_only=False).astype(np.int32)
+                nm = np.asarray(col.indices.is_null())
+                st = dict_state.setdefault(
+                    name, {"first": col.dictionary, "multi": False,
+                           "parts": []})
+                if not st["multi"] and not (
+                        col.dictionary is st["first"]
+                        or col.dictionary.equals(st["first"])):
+                    st["multi"] = True
+                zeroed = np.where(nm, 0, codes).astype(np.int32)
+                # dict columns assemble from st["parts"] alone (see
+                # _finish_dict_column); pieces[name] stays unused
+                st["parts"].append((zeroed, col.dictionary))
+            elif pa.types.is_fixed_size_list(col.type):
+                nm = np.asarray(col.is_null())
+                pieces[name].append(decode_fixed_size_list(col))
+            else:
+                nm = np.asarray(col.is_null())
+                if pa.types.is_integer(col.type):
+                    # stay in integer domain: to_numpy on a nullable int
+                    # array converts to float64, corrupting scaled-
+                    # decimal/int64 values above 2^53; fill_null copies,
+                    # so only when needed
+                    src = col.fill_null(0) if nm.any() else col
+                    pieces[name].append(src.to_numpy(zero_copy_only=False))
+                else:
+                    vals = col.to_numpy(zero_copy_only=False)
+                    if nm.any():
+                        vals = np.where(nm, 0, np.nan_to_num(vals))
+                    pieces[name].append(vals)
+            null_pieces[name].append(nm)
+
     arrays: Dict[str, np.ndarray] = {}
     nulls: Dict[str, np.ndarray] = {}
     dicts: Dict[str, np.ndarray] = {}
     kinds: Dict[str, Tuple[str, int]] = {}
     for i, name in enumerate(names):
-        field = table.schema.field(i)
-        meta = field.metadata or {}
+        meta = metas[i]
         kind = meta.get(b"ballista.kind", b"").decode() or None
         scale = int(meta.get(b"ballista.scale", b"0") or 0)
-        colarr = table.column(i)
-        chunk = colarr.chunk(0) if colarr.num_chunks else colarr.combine_chunks()
-        if pa.types.is_dictionary(chunk.type):
-            codes = chunk.indices.to_numpy(zero_copy_only=False).astype(np.int32)
-            null_mask = np.asarray(chunk.indices.is_null())
-            # a registry stamp resolves to the live interned Dictionary
-            # (content-verified by epoch) without touching the shipped
-            # values; otherwise adopt them once per content epoch so
-            # every part/read of equal content shares ONE instance
-            from .. import columnar_registry as _reg
-
-            stamp = meta.get(b"ballista.dict", b"").decode() or None
-            resolved = _reg.REGISTRY.resolve(stamp)
-            if resolved is None and _reg.enabled():
-                resolved = _reg.REGISTRY.adopt(
-                    stamp,
-                    np.asarray(chunk.dictionary.to_pylist(), dtype=object))
-            if resolved is not None:
-                dicts[name] = resolved
-            else:  # registry off: legacy raw value array
-                dicts[name] = np.asarray(chunk.dictionary.to_pylist(),
-                                         dtype=object)
-            arrays[name] = np.where(null_mask, 0, codes).astype(np.int32)
+        ftype = schema.field(i).type
+        if pa.types.is_dictionary(ftype):
+            arrays[name], dicts[name] = _finish_dict_column(
+                name, dict_state.get(name), meta)
             kinds[name] = ("utf8", 0)
-        elif pa.types.is_fixed_size_list(chunk.type):
-            null_mask = np.asarray(chunk.is_null())
-            arrays[name] = decode_fixed_size_list(chunk)
+        elif pa.types.is_fixed_size_list(ftype):
+            width = ftype.list_size
+            edtype = np.dtype(ftype.value_type.to_pandas_dtype())
+            arrays[name] = (
+                np.concatenate(pieces[name])
+                if pieces[name] else np.zeros((0, width), dtype=edtype))
             ekind = (meta.get(b"ballista.element_kind", b"").decode()
-                     or str(chunk.type.value_type))
+                     or str(ftype.value_type))
             escale = int(meta.get(b"ballista.element_scale", b"0") or 0)
             kinds[name] = (f"list:{ekind}", escale)
         else:
-            null_mask = np.asarray(chunk.is_null())
-            if pa.types.is_integer(chunk.type):
-                # stay in integer domain: to_numpy on a nullable int array
-                # converts to float64, corrupting scaled-decimal/int64
-                # values above 2^53; fill_null copies, so only when needed
-                src = chunk.fill_null(0) if null_mask.any() else chunk
-                vals = src.to_numpy(zero_copy_only=False)
-            else:
-                vals = chunk.to_numpy(zero_copy_only=False)
-                if null_mask.any():
-                    vals = np.where(null_mask, 0, np.nan_to_num(vals))
-            arrays[name] = vals
-            kinds[name] = (kind or str(chunk.type), scale)
-        nulls[name] = null_mask
-    return list(names), arrays, nulls, dicts, kinds
+            arrays[name] = _concat_pieces(pieces[name], ftype)
+            kinds[name] = (kind or str(ftype), scale)
+        nps = null_pieces[name]
+        nulls[name] = (nps[0] if len(nps) == 1
+                       else np.concatenate(nps) if nps
+                       else np.zeros(0, dtype=bool))
+    return names, arrays, nulls, dicts, kinds
+
+
+def _concat_pieces(ps: List[np.ndarray], ftype) -> np.ndarray:
+    if len(ps) == 1:
+        return ps[0]
+    if not ps:
+        return np.zeros(0, dtype=np.dtype(ftype.to_pandas_dtype()))
+    return np.concatenate(ps)
+
+
+def _finish_dict_column(name: str, st: Optional[dict], meta: dict):
+    """Assemble one utf8 column from its per-batch (codes, dictionary)
+    pieces. Single-dictionary streams (the writers' contract) resolve
+    the registry stamp or adopt the values once, exactly like the old
+    whole-table path; replacement dictionaries remap every batch onto
+    the registry's sorted union before concatenating."""
+    from .. import columnar_registry as _reg
+
+    if st is None or not st["parts"]:
+        return np.zeros(0, dtype=np.int32), np.asarray([], dtype=object)
+    if st["multi"]:
+        parts = [
+            (codes, np.asarray(d.to_pylist(), dtype=object))
+            for codes, d in st["parts"]
+        ]
+        unified, remapped = _reg.unify_parts(parts)
+        codes = (remapped[0] if len(remapped) == 1
+                 else np.concatenate(remapped)).astype(np.int32)
+        return codes, unified
+    codes_list = [codes for codes, _ in st["parts"]]
+    codes = (codes_list[0] if len(codes_list) == 1
+             else np.concatenate(codes_list))
+    # a registry stamp resolves to the live interned Dictionary
+    # (content-verified by epoch) without touching the shipped values;
+    # otherwise adopt them once per content epoch so every part/read of
+    # equal content shares ONE instance
+    stamp = meta.get(b"ballista.dict", b"").decode() or None
+    resolved = _reg.REGISTRY.resolve(stamp)
+    if resolved is None and _reg.enabled():
+        resolved = _reg.REGISTRY.adopt(
+            stamp,
+            np.asarray(st["first"].to_pylist(), dtype=object))
+    if resolved is not None:
+        return codes, resolved
+    # registry off: legacy raw value array
+    return codes, np.asarray(st["first"].to_pylist(), dtype=object)
 
 
 def unify_dictionaries(

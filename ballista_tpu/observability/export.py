@@ -60,7 +60,7 @@ import time
 from typing import Dict, List, Optional
 
 LANE_NAMES = ("parse", "h2d", "compile_trace_lower", "device_blocked",
-              "host_dictionary", "xla_execute_other")
+              "host_dictionary", "shuffle_spill", "xla_execute_other")
 
 
 def compute_lanes(session: dict) -> dict:
@@ -83,6 +83,10 @@ def compute_lanes(session: dict) -> dict:
         "h2d": round(h2d, 6),
         "device_blocked": round(span_sum("device.block"), 6),
         "host_dictionary": round(span_sum("host.dictionary"), 6),
+        # disk time the shuffle governor's spill writes/re-reads add
+        # (distributed/spill.py) — zero unless the memory budget forced
+        # chunks to disk
+        "shuffle_spill": round(span_sum("shuffle.spill"), 6),
     }
     compile_lane = sum(float(r.get("call_seconds", 0.0)) for r in records
                        if r.get("name") == "compile.jit")

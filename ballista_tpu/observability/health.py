@@ -196,13 +196,18 @@ def base_process_samples() -> List[Sample]:
     category), device bytes."""
     from . import memory as obs_memory
 
+    from ..distributed import spill as _spill
+
     snap = obs_memory.memory_snapshot()
+    gov = _spill.governor().stats()
     out: List[Sample] = [
         ("ballista_rss_bytes", {}, snap["rss_bytes"]),
         ("ballista_host_tracked_bytes", {}, snap["current_bytes"]),
         ("ballista_host_tracked_peak_bytes", {}, snap["peak_bytes"]),
         ("ballista_device_bytes", {}, snap["device_bytes"]),
         ("ballista_device_peak_bytes", {}, snap["peak_device_bytes"]),
+        ("ballista_shuffle_inflight_bytes", {}, gov["inflight_bytes"]),
+        ("ballista_spill_bytes_total", {}, gov["spilled_bytes_total"]),
     ]
     for cat, n in sorted(snap["by_category"].items()):
         out.append(("ballista_host_category_bytes", {"category": cat}, n))

@@ -61,6 +61,9 @@ OPERATOR_METRICS = {
     "local_reads": ("counter", "shuffle partitions read from local disk"),
     "remote_fetches": ("counter", "shuffle partitions fetched over the "
                                   "data plane"),
+    "spilled_bytes": ("counter", "fetched shuffle chunk bytes diverted "
+                                 "to disk past the memory budget "
+                                 "watermark"),
     "bytes_written": ("counter", "partition/shuffle output bytes"),
     "elapsed_write": ("timer", "partition IPC write time"),
     "selectivity": ("gauge", "filter pass fraction"),
@@ -80,6 +83,12 @@ PROCESS_METRICS = {
     "ballista_device_bytes": ("gauge", "device bytes in use (live arrays / "
                                        "allocator stats)"),
     "ballista_device_peak_bytes": ("gauge", "peak observed device bytes"),
+    # shuffle memory governor (distributed/spill.py)
+    "ballista_shuffle_inflight_bytes": ("gauge", "governed in-flight "
+                                                 "shuffle buffer bytes"),
+    "ballista_spill_bytes_total": ("counter", "shuffle chunk bytes "
+                                              "spilled to disk past the "
+                                              "budget watermark"),
     # executor
     "ballista_inflight_tasks": ("gauge", "tasks currently executing"),
     "ballista_ingest_pool_depth": ("gauge", "queued work items waiting on "

@@ -110,9 +110,29 @@ KNOBS: Dict[str, tuple] = {
                                      "shuffle IPC"),
     "BALLISTA_ALLOW_MIMALLOC": ("off", "skip the jemalloc pool guard for "
                                        "pyarrow"),
-    # distributed
+    # distributed / streaming shuffle (docs/shuffle.md)
     "BALLISTA_NATIVE_DATAPLANE": ("on", "serve shuffle partitions from the "
                                         "native C++ daemon (off = Python)"),
+    "BALLISTA_SHUFFLE_CHUNK_BYTES": ("4194304", "max Arrow-IPC record-"
+                                                "batch / wire-frame size "
+                                                "on the shuffle path"),
+    "BALLISTA_SHUFFLE_MEM_BUDGET": ("268435456", "per-process cap on "
+                                                 "in-flight shuffle "
+                                                 "buffer bytes"),
+    "BALLISTA_SHUFFLE_SPILL_WATERMARK": ("0.8", "budget fraction past "
+                                                "which fetched chunks "
+                                                "divert to disk"),
+    "BALLISTA_SHUFFLE_SPILL_DIR": ("tempdir/ballista-spill-<pid>",
+                                   "directory for size-rotated spill "
+                                   "segments"),
+    "BALLISTA_SHUFFLE_SPILL_FILE_MB": ("64", "spill segment rotation "
+                                             "size"),
+    "BALLISTA_SHUFFLE_WINDOW_BYTES": ("4x chunk bytes", "flow-control "
+                                                        "window: max "
+                                                        "unacked in-"
+                                                        "flight bytes "
+                                                        "per peer "
+                                                        "stream"),
     "BALLISTA_MESH_GROUP_ACK_TIMEOUT": ("3600", "multi-process mesh group "
                                                 "broadcast ack timeout "
                                                 "(seconds)"),
@@ -246,6 +266,10 @@ SYSTEM_SCHEMAS: Dict[str, Schema] = {
         ("num_devices", Int64), ("rss_bytes", Int64),
         ("device_bytes", Int64), ("inflight_tasks", Int64),
         ("ingest_pool_depth", Int64), ("peak_host_bytes", Int64),
+        # shuffle memory governor (distributed/spill.py): governed
+        # in-flight shuffle buffer bytes + cumulative spill, per
+        # heartbeat
+        ("shuffle_inflight_bytes", Int64), ("spill_bytes_total", Int64),
         # live progress plane: scheduler-side clock minus the last
         # heartbeat; stale=1 past BALLISTA_EXECUTOR_STALE_SECS (or when
         # the executor never heartbeated this scheduler lifetime)
@@ -803,6 +827,7 @@ def _local_executor_rows() -> List[dict]:
         n_devices = len(jax.devices())
     except Exception:  # noqa: BLE001 - backend not initializable
         n_devices = 0
+    gov = _gov_stats()
     return [{
         "executor_id": "standalone",
         "host": socket.gethostname(),
@@ -813,10 +838,18 @@ def _local_executor_rows() -> List[dict]:
         "inflight_tasks": 0,
         "ingest_pool_depth": pool_queue_depth(),
         "peak_host_bytes": obs_memory.peak_host_bytes(),
+        "shuffle_inflight_bytes": gov["inflight_bytes"],
+        "spill_bytes_total": gov["spilled_bytes_total"],
         # the current process IS the executor: its heartbeat is now
         "heartbeat_age_seconds": 0.0,
         "stale": 0,
     }]
+
+
+def _gov_stats() -> dict:
+    from ..distributed import spill as _spill
+
+    return _spill.governor().stats()
 
 
 def _local_tasks_rows() -> List[dict]:

@@ -203,8 +203,11 @@ class ShuffleReaderExec(PhysicalPlan):
         """Fetch+decode ONE shuffle file (local filesystem or data-plane
         socket). Runs on ingest pool workers when a group has several
         producers — the fetches overlap instead of serializing one
-        network round-trip per producer. Metric increments from worker
-        threads ride the usual benign-race policy."""
+        network round-trip per producer. Local reads decode the
+        memory-mapped stream file incrementally; remote fetches stream
+        bounded chunks through the governed ChunkBuffer (disk spill past
+        the budget watermark). Metric increments from worker threads
+        ride the usual benign-race policy."""
         from ..io import ipc
 
         m = self.metrics()
@@ -213,10 +216,7 @@ class ShuffleReaderExec(PhysicalPlan):
             m.add_counter("local_reads")
             _, arrays, nulls, dicts, _ = ipc.read_partition_arrays(loc.path)
         else:
-            buf = self._fetch_with_retry(loc)
-            m.add_counter("bytes_read", len(buf))
-            m.add_counter("remote_fetches")
-            _, arrays, nulls, dicts, _ = ipc.read_partition_arrays(buf)
+            arrays, nulls, dicts = self._fetch_with_retry(loc)
         return arrays, nulls, dicts
 
     def _load_group(self, q: int) -> List[ColumnBatch]:
@@ -279,15 +279,17 @@ class ShuffleReaderExec(PhysicalPlan):
                 return
             self._inflight[q] = ingest_pool().submit(self._bg_load, q)
 
-    def _fetch_with_retry(self, loc: PartitionLocation) -> bytes:
-        """One quick retry rides out transient hiccups; a persistent
-        failure (producer executor dead, data lost, or no known address)
-        raises a tagged ShuffleFetchError the scheduler can act on by
-        re-queueing the producer partition."""
+    def _fetch_with_retry(self, loc: PartitionLocation):
+        """Streaming fetch+decode of one producer file with one quick
+        retry for transient hiccups; a persistent failure (producer
+        executor dead mid-stream, data lost, truncated wire or spill
+        bytes, or no known address) raises a tagged ShuffleFetchError
+        the scheduler can act on by re-queueing the producer partition —
+        recovery works from a half-consumed stream because the attempt's
+        partial buffers are released and the re-run refetches whole."""
         import time as _time
 
-        from ..distributed.dataplane import fetch_partition_bytes
-        from ..errors import ShuffleFetchError
+        from ..errors import QueryCancelled, ShuffleFetchError
         from ..lifecycle import check_cancel
         from ..observability import trace_span
         from ..testing.faults import fault_point
@@ -314,11 +316,9 @@ class ShuffleReaderExec(PhysicalPlan):
                     fault_point("shuffle.fetch", stage=loc.stage_id,
                                 partition=loc.partition_id,
                                 attempt=attempt)
-                    return fetch_partition_bytes(
-                        loc.host, loc.port, loc.job_id, loc.stage_id,
-                        loc.partition_id, shuffle_output=loc.shuffle_output,
-                        timeout=10.0,
-                    )
+                    return self._fetch_stream_once(loc, attempt)
+            except QueryCancelled:
+                raise  # chunk-level cancel is terminal, never retried
             except Exception as e:  # noqa: BLE001 - any transport failure
                 last = e
                 if attempt == 0:
@@ -327,6 +327,41 @@ class ShuffleReaderExec(PhysicalPlan):
             loc.stage_id, [loc.partition_id], loc.executor_id,
             f"{type(last).__name__}: {last}",
         )
+
+    def _fetch_stream_once(self, loc: PartitionLocation, attempt: int):
+        """One streaming fetch attempt: wire chunks land in a governed
+        ChunkBuffer (RAM within the budget, size-rotated spill files
+        past the watermark — never a blocking wait), then decode replays
+        them incrementally. The cancel token is checked at EVERY chunk
+        boundary on both the receive and decode loops, so
+        ``ctx.cancel()``/deadlines abort in-flight transfers within one
+        chunk."""
+        from ..distributed.dataplane import fetch_partition_chunks
+        from ..distributed.spill import ChunkBuffer
+        from ..io import ipc
+        from ..lifecycle import check_cancel
+        from ..testing.faults import fault_point
+
+        m = self.metrics()
+        buf = ChunkBuffer()
+        try:
+            for chunk in fetch_partition_chunks(
+                    loc.host, loc.port, loc.job_id, loc.stage_id,
+                    loc.partition_id, shuffle_output=loc.shuffle_output,
+                    timeout=10.0):
+                check_cancel()
+                fault_point("shuffle.stream.chunk", stage=loc.stage_id,
+                            partition=loc.partition_id, attempt=attempt)
+                buf.put(chunk)
+            _, arrays, nulls, dicts, _ = \
+                ipc.read_partition_arrays_from_chunks(buf.chunks())
+        finally:
+            buf.close()
+        m.add_counter("bytes_read", buf.total_bytes)
+        m.add_counter("remote_fetches")
+        if buf.spilled_bytes:
+            m.add_counter("spilled_bytes", buf.spilled_bytes)
+        return arrays, nulls, dicts
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
         batches = self._take_group(partition)

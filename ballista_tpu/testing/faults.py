@@ -45,6 +45,15 @@ scheduler.progress_report  executor-side TaskProgress piggyback assembly
                      (drop = skip this round's samples, delay = stall
                      them, fail = swallowed — progress is best-effort
                      and results must stay byte-identical)
+shuffle.spill.write  spill-pool segment append (fail = IoError-shaped
+                     disk fault; drop = TORN write — half the payload
+                     reaches disk, the re-read detects SpillCorrupt)
+shuffle.stream.chunk consumer-side chunk receive, per chunk (fail =
+                     mid-transfer transport fault; delay = slow
+                     consumer exercising flow control)
+dataplane.flow       server-side chunk-stream writer, per chunk (drop =
+                     close mid-stream like a crashed peer; fail =
+                     tagged error frame to the reader)
 ==================== =======================================================
 
 Disabled cost: one module-global ``is None`` check per hit — the
@@ -74,6 +83,12 @@ FAULT_POINTS: Dict[str, str] = {
     "client.rpc": "SchedulerClient RPC, client side",
     "scheduler.progress_report": "executor TaskProgress piggyback "
                                  "assembly (live progress plane)",
+    "shuffle.spill.write": "spill-pool segment append (drop = torn "
+                           "write)",
+    "shuffle.stream.chunk": "consumer-side chunk receive on the "
+                            "streaming shuffle fetch",
+    "dataplane.flow": "server-side chunk-stream writer (drop = close "
+                      "mid-stream)",
 }
 
 

@@ -515,6 +515,15 @@ def _run_bench(args) -> None:
             obs_memory.peak_device_bytes(refresh=True))
         result["peak_host_tracked_bytes"] = int(
             obs_memory.peak_host_bytes())
+        # shuffle memory governor (ISSUE 12): in-flight peak + spill
+        # volume per JSON line; the fixed-budget q5 phase below resets
+        # and re-reads them for its gated fields
+        from ballista_tpu.distributed import spill as _spill
+
+        gov = _spill.governor().stats()
+        result["spill_bytes"] = int(gov["spilled_bytes_total"])
+        result["shuffle_peak_inflight_mb"] = round(
+            gov["peak_inflight_bytes"] / 1e6, 2)
 
     def snapshot(phase: str):
         result["partial"] = phase
@@ -613,6 +622,20 @@ def _run_bench(args) -> None:
                    args.runs, result, timed, lane_prefix="q16_")
     snapshot("q16_done")
 
+    # -- fixed-budget spill q5 (ISSUE 12: memory-governed streaming
+    # shuffle). q5 on an in-process LocalCluster with remote fetches
+    # forced and a small BALLISTA_SHUFFLE_MEM_BUDGET: every shuffle
+    # read streams through the governor and past-watermark chunks
+    # spill to disk. Gated by dev/check_bench_regress.py — spill_bytes
+    # must stay nonzero (the lane engaged) and the in-flight peak must
+    # respect the budget (absolute budget_check).
+    try:
+        _spill_q5(data_dir, result, qdir)
+    except Exception as e:  # noqa: BLE001 - phase is best-effort
+        print(f"# spill q5 failed: {e}", file=sys.stderr)
+        result["spill_q5_error"] = str(e)[:200]
+    snapshot("spill_q5_done")
+
     # -- per-stage decomposition + AOT kernel + MFU estimate ----------------
     try:
         result["stages"] = instrument_q1(data_dir, args.runs)
@@ -649,6 +672,62 @@ def _run_bench(args) -> None:
     # flush so the parent's watchdog can salvage the line even if this
     # process subsequently wedges in teardown and gets killed
     print(json.dumps(result), flush=True)
+
+
+def _spill_q5(data_dir: str, result: dict, qdir: str) -> None:
+    """Fixed-budget q5 on an in-process LocalCluster: remote fetches
+    forced so every shuffle read streams through the governed data
+    plane, with a budget small enough that past-watermark chunks spill
+    to size-rotated disk files. Emits the gated fields: wall time,
+    spill volume, in-flight peak and the configured budget."""
+    from benchmarks.tpch.schema_def import register_tpch
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.distributed import spill as _spill
+    from ballista_tpu.distributed.executor import LocalCluster
+    from ballista_tpu.observability import memory as obs_memory
+    from ballista_tpu.physical.shuffle import ShuffleReaderExec
+
+    # 128 KiB budget / 32 KiB chunks: in-flight wire bytes are bounded
+    # by parts concurrently in fetch+decode (each part's buffer drains
+    # at decode), so the budget must sit BELOW one part's wire volume
+    # to genuinely force the spill lane at bench scales (>= 0.1)
+    budget = 128 << 10
+    chunk = 32 << 10
+    saved = {k: os.environ.get(k) for k in
+             ("BALLISTA_SHUFFLE_MEM_BUDGET", "BALLISTA_SHUFFLE_CHUNK_BYTES")}
+    os.environ["BALLISTA_SHUFFLE_MEM_BUDGET"] = str(budget)
+    os.environ["BALLISTA_SHUFFLE_CHUNK_BYTES"] = str(chunk)
+    force_remote0 = ShuffleReaderExec.FORCE_REMOTE
+    ShuffleReaderExec.FORCE_REMOTE = True
+    gov = _spill.governor()
+    gov.reset_stats()
+    rss0 = obs_memory.peak_rss_bytes()
+    cluster = LocalCluster(num_executors=2, concurrent_tasks=2)
+    try:
+        ctx = BallistaContext.remote("localhost", cluster.port,
+                                     **{"job.timeout": "600"})
+        register_tpch(ctx, data_dir, "tbl")
+        sql = open(os.path.join(qdir, "q5.sql")).read()
+        t0 = time.time()
+        ctx.sql(sql).collect()
+        wall = time.time() - t0
+    finally:
+        cluster.shutdown()
+        ShuffleReaderExec.FORCE_REMOTE = force_remote0
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    st = gov.stats()
+    result["spill_q5_seconds"] = round(wall, 4)
+    result["spill_bytes"] = int(st["spilled_bytes_total"])
+    result["shuffle_peak_inflight_mb"] = round(
+        st["peak_inflight_bytes"] / 1e6, 2)
+    result["spill_budget_mb"] = round(budget / 1e6, 2)
+    result["spill_chunk_mb"] = round(chunk / 1e6, 2)
+    result["spill_q5_peak_rss_mb"] = round(
+        max(obs_memory.peak_rss_bytes(), rss0) / 1e6, 1)
 
 
 def _count_lineitem_rows(data_dir: str) -> int:

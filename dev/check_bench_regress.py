@@ -75,7 +75,35 @@ METRICS: Dict[str, Tuple[str, float]] = {
     # speedups. Absent from pre-PR-10 baselines (compare() skips
     # missing keys).
     "progress_samples": ("nonzero", 0.0),
+    # PR 12 (memory-governed streaming shuffle): the fixed-budget q5
+    # cluster run. spill_bytes reads 0 if the spill lane silently dies;
+    # the in-flight peak and the run's RSS must not regrow round-over-
+    # round (the ABSOLUTE peak<=budget gate is budget_check below).
+    "spill_bytes": ("nonzero", 0.0),
+    "shuffle_peak_inflight_mb": ("lower", 0.50),
+    "spill_q5_seconds": ("lower", 0.50),
+    "spill_q5_peak_rss_mb": ("lower", 0.35),
 }
+
+
+def budget_check(new: dict) -> int:
+    """Absolute gate for the fixed-budget q5 run: the governed in-flight
+    peak must respect the configured shuffle memory budget (plus one
+    chunk of slack — a charge is refused only once it would CROSS the
+    watermark). Returns the number of violations."""
+    peak = new.get("shuffle_peak_inflight_mb")
+    budget = new.get("spill_budget_mb")
+    if peak is None or budget is None:
+        return 0
+    slack = float(new.get("spill_chunk_mb", 4.0))
+    if float(peak) > float(budget) + slack:
+        print(f"regressed  shuffle_peak_inflight_mb: {peak} MB exceeds "
+              f"the configured budget {budget} MB (+{slack} MB chunk "
+              "slack)")
+        return 1
+    print(f"ok         shuffle_peak_inflight_mb: {peak} MB within "
+          f"budget {budget} MB")
+    return 0
 
 
 def last_json_line(path: str) -> Optional[dict]:
@@ -194,6 +222,15 @@ def self_test() -> int:
     rows = {r[0]: r for r in compare({"progress_samples": 8},
                                      {"progress_samples": 0})}
     assert rows["progress_samples"][4] is True
+    # absolute budget gate: in-flight peak past budget+chunk fails,
+    # within it passes, absent fields are a no-op
+    assert budget_check({"shuffle_peak_inflight_mb": 7.5,
+                         "spill_budget_mb": 8.0,
+                         "spill_chunk_mb": 1.0}) == 0
+    assert budget_check({"shuffle_peak_inflight_mb": 20.0,
+                         "spill_budget_mb": 8.0,
+                         "spill_chunk_mb": 1.0}) == 1
+    assert budget_check({}) == 0
     print("self-test ok")
     return 0
 
@@ -219,8 +256,9 @@ def main() -> int:
     new = last_json_line(args.new)
     if old is None or new is None:
         return 2
-    return report(compare(old, new, args.tolerance_scale),
-                  args.tolerance_scale)
+    rc = report(compare(old, new, args.tolerance_scale),
+                args.tolerance_scale)
+    return rc or (1 if budget_check(new) else 0)
 
 
 if __name__ == "__main__":

@@ -291,6 +291,20 @@ def apply_progress(fdp: dp.FileDescriptorProto) -> None:
               F.TYPE_MESSAGE, type_name=".ballista_tpu.JobProgress")
 
 
+def apply_spill(fdp: dp.FileDescriptorProto) -> None:
+    """PR 12: memory-governed streaming shuffle (mirrored by hand in
+    ballista.proto; dev/check_proto_sync.py guards the drift) — the
+    data-plane chunk-stream negotiation field on Action and the shuffle
+    governor gauges riding the executor heartbeat."""
+    add_field(get_message(fdp, "Action"), "stream_window", 11,
+              F.TYPE_UINT64)
+    add_field(get_message(fdp, "Action"), "stream_chunk", 12,
+              F.TYPE_UINT64)
+    res = get_message(fdp, "ExecutorResources")
+    add_field(res, "shuffle_inflight_bytes", 6, F.TYPE_UINT64)
+    add_field(res, "spill_bytes_total", 7, F.TYPE_UINT64)
+
+
 TEMPLATE = '''# -*- coding: utf-8 -*-
 # Generated by dev/gen_proto_patch.py (no protoc in this image). DO NOT EDIT!
 # source: ballista.proto
@@ -324,6 +338,7 @@ def main() -> None:
     apply_systables(fdp)
     apply_lifecycle(fdp)
     apply_progress(fdp)
+    apply_spill(fdp)
     out = TEMPLATE.format(blob=fdp.SerializeToString())
     with open(PB2, "w") as f:
         f.write(out)

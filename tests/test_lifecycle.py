@@ -715,6 +715,36 @@ CHAOS_SEEDS = {
                        {"BALLISTA_PROGRESS_INTERVAL_SECS": "0.05"}, True),
     "progress-fail": ("scheduler.progress_report=fail-every:1", {},
                       {"BALLISTA_PROGRESS_INTERVAL_SECS": "0.05"}, True),
+    # streaming shuffle data plane (docs/shuffle.md): chunk-level
+    # faults on the flow-controlled stream. Tiny chunk size forces
+    # multi-chunk streams on this small table.
+    "stream-chunk-fail": ("shuffle.stream.chunk=fail-once:2", {},
+                          {"BALLISTA_SHUFFLE_CHUNK_BYTES": "1024"}, True),
+    "stream-chunk-delay": ("shuffle.stream.chunk=delay:40", {},
+                           {"BALLISTA_SHUFFLE_CHUNK_BYTES": "1024"}, True),
+    # mid-stream executor death: the serving side closes the connection
+    # between chunks (drop), or streams a tagged error frame every time
+    # (fail) — recovery must re-queue the producer or terminate cleanly
+    "flow-drop-midstream": ("dataplane.flow=drop-once:2", {},
+                            {"BALLISTA_NATIVE_DATAPLANE": "off",
+                             "BALLISTA_SHUFFLE_CHUNK_BYTES": "1024"}, True),
+    "flow-fail-always": ("dataplane.flow=fail-every:1", {},
+                         {"BALLISTA_NATIVE_DATAPLANE": "off",
+                          "BALLISTA_SHUFFLE_CHUNK_BYTES": "1024"}, False),
+    # spill lane: a tiny budget forces every fetched chunk to disk —
+    # results must stay byte-identical streaming-from-disk
+    "spill-forced": ("", {},
+                     {"BALLISTA_SHUFFLE_MEM_BUDGET": "4096",
+                      "BALLISTA_SHUFFLE_CHUNK_BYTES": "1024"}, True),
+    # torn spill write (drop = half the payload reaches disk): the
+    # replay detects the corrupt segment, the fetch retries and the
+    # second attempt's spill is clean — truncated-spill recovery
+    "spill-torn-write": ("shuffle.spill.write=drop-once", {},
+                         {"BALLISTA_SHUFFLE_MEM_BUDGET": "4096",
+                          "BALLISTA_SHUFFLE_CHUNK_BYTES": "1024"}, True),
+    "spill-write-fail": ("shuffle.spill.write=fail-once", {},
+                         {"BALLISTA_SHUFFLE_MEM_BUDGET": "4096",
+                          "BALLISTA_SHUFFLE_CHUNK_BYTES": "1024"}, True),
 }
 
 

@@ -1,0 +1,486 @@
+"""Memory-governed streaming shuffle: governor accounting, spill pool,
+chunked IPC, flow-controlled data plane, and the spill-on/spill-off
+determinism sweep (docs/shuffle.md).
+
+The reference materializes whole partitions in memory on both shuffle
+ends; this engine streams bounded Arrow-IPC chunks through a per-process
+memory budget with disk spill past the watermark. These tests pin the
+invariants that make that safe: charges always drain back to zero,
+spilled chunks replay byte-identically (and a truncated segment is
+DETECTED, never silently decoded), a saturated budget degrades to
+streaming-from-disk rather than blocking, cancellation lands at chunk
+boundaries, and query results are byte-identical spill-on vs spill-off
+on both execution paths.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ballista_tpu import Int64, Utf8, schema
+from ballista_tpu.client import BallistaContext
+from ballista_tpu.columnar import ColumnBatch
+from ballista_tpu.distributed import dataplane, spill
+from ballista_tpu.distributed.executor import LocalCluster
+from ballista_tpu.errors import IoError, QueryCancelled
+from ballista_tpu.io import ipc
+from ballista_tpu.lifecycle import CancelToken, bind_token
+from ballista_tpu.physical.shuffle import ShuffleReaderExec
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _mkbatch(n=5000):
+    s = schema(("a", Int64), ("k", Utf8))
+    return s, ColumnBatch.from_pydict(s, {
+        "a": list(range(n)),
+        "k": [f"v{i % 7}" for i in range(n)],
+    })
+
+
+# ---------------------------------------------------------------------------
+# governor accounting units
+# ---------------------------------------------------------------------------
+
+
+def test_governor_charge_release_watermark(monkeypatch):
+    monkeypatch.setenv("BALLISTA_SHUFFLE_MEM_BUDGET", "10000")
+    monkeypatch.setenv("BALLISTA_SHUFFLE_SPILL_WATERMARK", "0.8")
+    gov = spill.ShuffleMemoryGovernor()
+    assert gov.try_charge(4000) and gov.try_charge(4000)
+    assert gov.inflight_bytes == 8000
+    # 8000 + 4000 crosses the 8000 watermark -> refused, not blocked
+    assert not gov.try_charge(4000)
+    assert gov.denials == 1
+    assert gov.inflight_bytes == 8000  # refused charge did not land
+    gov.release(4000)
+    assert gov.try_charge(100)
+    gov.release(4100)
+    gov.release(999999)  # over-release clamps at zero, never negative
+    assert gov.inflight_bytes == 0
+    assert gov.peak_inflight_bytes == 8000
+    gov.note_spill(1234)
+    st = gov.stats()
+    assert st["spilled_bytes_total"] == 1234
+    assert st["spill_chunks_total"] == 1
+
+
+def test_governor_budget_is_dynamic(monkeypatch):
+    """Knob reads happen per charge: tests/bench re-point the budget
+    without process restarts or governor resets."""
+    gov = spill.ShuffleMemoryGovernor()
+    monkeypatch.setenv("BALLISTA_SHUFFLE_MEM_BUDGET", "8192")
+    assert not gov.try_charge(8000)
+    monkeypatch.setenv("BALLISTA_SHUFFLE_MEM_BUDGET", str(1 << 20))
+    assert gov.try_charge(8000)
+    gov.release(8000)
+
+
+# ---------------------------------------------------------------------------
+# spill pool: rotation, refcounted cleanup, truncation detection
+# ---------------------------------------------------------------------------
+
+
+def test_spill_pool_rotates_and_unlinks(tmp_path):
+    pool = spill.SpillPool(str(tmp_path), max_file_bytes=1000)
+    refs = [pool.append(bytes([i]) * 600) for i in range(4)]
+    # 600B chunks against a 1000B rotation bound: segments roll over
+    assert pool.segments_created >= 2
+    for i, r in enumerate(refs):
+        assert r.read() == bytes([i]) * 600
+    live = {r._seg.path for r in refs}
+    for r in refs:
+        r.release()
+    pool.close()
+    for path in live:
+        assert not os.path.exists(path), f"segment survived release: {path}"
+
+
+def test_truncated_spill_segment_detected(tmp_path):
+    pool = spill.SpillPool(str(tmp_path), max_file_bytes=1 << 20)
+    ref = pool.append(b"x" * 500)
+    with open(ref._seg.path, "r+b") as fh:
+        fh.truncate(100)
+    with pytest.raises(IoError):  # SpillCorrupt is IoError-shaped
+        ref.read()
+    ref.release()
+    pool.close()
+
+
+def test_torn_write_mid_segment_detected(tmp_path, monkeypatch):
+    """A torn write that is NOT the last chunk of its segment must
+    still be detected: later appends land at the file's REAL end, so
+    the torn chunk's window would otherwise read back the neighbor's
+    bytes with no short read at all."""
+    from ballista_tpu.testing.faults import reload_faults
+
+    monkeypatch.setenv("BALLISTA_FAULTS", "shuffle.spill.write=drop-once")
+    reload_faults()
+    try:
+        pool = spill.SpillPool(str(tmp_path), max_file_bytes=1 << 20)
+        torn = pool.append(b"A" * 1000)   # drop-once: 500 bytes on disk
+        after = pool.append(b"B" * 1000)  # appends at the real end
+        with pytest.raises(IoError, match="torn"):
+            torn.read()
+        assert after.read() == b"B" * 1000
+        torn.release()
+        after.release()
+        pool.close()
+    finally:
+        monkeypatch.delenv("BALLISTA_FAULTS")
+        reload_faults()
+
+
+def test_chunk_buffer_spills_and_replays_in_order(tmp_path, monkeypatch):
+    monkeypatch.setenv("BALLISTA_SHUFFLE_MEM_BUDGET", "8192")
+    monkeypatch.setenv("BALLISTA_SHUFFLE_SPILL_DIR", str(tmp_path))
+    spill._reset_pool()
+    gov = spill.governor()
+    base = gov.stats()["spilled_bytes_total"]
+    buf = spill.ChunkBuffer()
+    chunks = [bytes([i]) * 3000 for i in range(8)]  # 24 KB >> budget
+    for c in chunks:
+        buf.put(c)
+    assert buf.spilled_bytes > 0, "tiny budget must divert to disk"
+    assert gov.stats()["spilled_bytes_total"] > base
+    assert b"".join(buf.chunks()) == b"".join(chunks)
+    buf.close()
+    # every charge drained: saturating then consuming leaks no budget
+    assert gov.inflight_bytes == 0
+    spill._reset_pool()
+
+
+def test_chunk_buffer_close_releases_unconsumed(monkeypatch, tmp_path):
+    monkeypatch.setenv("BALLISTA_SHUFFLE_MEM_BUDGET", str(1 << 20))
+    monkeypatch.setenv("BALLISTA_SHUFFLE_SPILL_DIR", str(tmp_path))
+    spill._reset_pool()
+    gov = spill.governor()
+    before = gov.inflight_bytes
+    buf = spill.ChunkBuffer()
+    for _ in range(4):
+        buf.put(b"y" * 2000)
+    assert gov.inflight_bytes > before
+    buf.close()  # error path: nothing consumed
+    assert gov.inflight_bytes == before
+    spill._reset_pool()
+
+
+# ---------------------------------------------------------------------------
+# chunked IPC writer / incremental reader
+# ---------------------------------------------------------------------------
+
+
+def test_partition_writer_bounds_record_batches(tmp_path):
+    s, b = _mkbatch()
+    whole = str(tmp_path / "whole" / "data.arrow")
+    sliced = str(tmp_path / "sliced" / "data.arrow")
+    ipc.write_partition(whole, [b])
+    w = ipc.PartitionWriter(sliced, chunk_bytes=4096)
+    w.write_batch(b)
+    st = w.close()
+    assert st["num_batches"] > 4, "4 KiB bound must split the batch"
+    n1, a1, _, d1, _ = ipc.read_partition_arrays(whole)
+    n2, a2, _, d2, _ = ipc.read_partition_arrays(sliced)
+    for name in n1:
+        assert np.array_equal(a1[name], a2[name]), name
+
+
+def test_reader_sniffs_legacy_file_format(tmp_path):
+    """Pre-PR files (random-access FILE format) stay readable — the
+    reader dispatches on the ARROW1 magic."""
+    import pyarrow as pa
+
+    s, b = _mkbatch(100)
+    rb = ipc.batch_to_arrow(b)
+    path = str(tmp_path / "legacy.arrow")
+    with pa.OSFile(path, "wb") as sink:
+        with pa.ipc.new_file(sink, rb.schema) as writer:
+            writer.write_batch(rb)
+    names, arrays, _, dicts, kinds = ipc.read_partition_arrays(path)
+    assert list(arrays["a"]) == list(range(100))
+    assert kinds["a"] == ("int64", 0)
+    # and the stream-format path through a buffer works too
+    stream_path = str(tmp_path / "s" / "data.arrow")
+    ipc.write_partition(stream_path, [b])
+    buf = open(stream_path, "rb").read()
+    names2, arrays2, _, _, _ = ipc.read_partition_arrays(buf)
+    assert np.array_equal(arrays2["a"], arrays["a"])
+
+
+def test_incremental_decode_checks_cancel(tmp_path):
+    """Chunk-level cancellation: a token fired mid-decode aborts at the
+    next record-batch boundary instead of finishing the partition."""
+    s, b = _mkbatch()
+    path = str(tmp_path / "p" / "data.arrow")
+    w = ipc.PartitionWriter(path, chunk_bytes=2048)
+    w.write_batch(b)
+    w.close()
+    token = CancelToken()
+    raw = open(path, "rb").read()
+
+    def chunks():
+        yield raw[:3000]
+        token.cancel("test")
+        yield raw[3000:]
+
+    with bind_token(token):
+        with pytest.raises(QueryCancelled):
+            ipc.read_partition_arrays_from_chunks(chunks())
+
+
+# ---------------------------------------------------------------------------
+# flow-controlled data plane
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def plane(tmp_path):
+    s, b = _mkbatch()
+    wd = str(tmp_path / "wd")
+    path = dataplane.partition_path(wd, "jobs1", 1, 0)
+    ipc.write_partition(path, [b])
+    server = dataplane.DataPlaneServer("localhost", 0, wd)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield server, path, s
+    finally:
+        server.close()
+
+
+def test_stream_fetch_flow_control(plane):
+    server, path, _ = plane
+    raw = open(path, "rb").read()
+    # window smaller than the payload: the server must suspend on acks
+    chunks = list(dataplane.fetch_partition_chunks(
+        "localhost", server.port, "jobs1", 1, 0,
+        window_bytes=8192, chunk_bytes=4096))
+    assert len(chunks) > 4
+    assert b"".join(chunks) == raw
+
+
+def test_stream_fetch_legacy_framing(plane):
+    """A server without the streaming extension (the native C++ daemon
+    path) answers whole-payload; the client still consumes in bounded
+    chunks."""
+    server, path, _ = plane
+    raw = open(path, "rb").read()
+    server.stream_serve = False
+    try:
+        chunks = list(dataplane.fetch_partition_chunks(
+            "localhost", server.port, "jobs1", 1, 0, chunk_bytes=4096))
+    finally:
+        server.stream_serve = True
+    assert len(chunks) > 4
+    assert b"".join(chunks) == raw
+
+
+def test_stream_abort_on_cancelled_job(plane):
+    # a DISTINCT job id: the cancelled-job registry is process-global
+    # by design (ids are unique in production), so poisoning the shared
+    # fixture id would cancel every later test's streams too
+    server, path, _ = plane
+    import shutil
+
+    dead = dataplane.partition_path(server.work_dir, "jobdead", 1, 0)
+    os.makedirs(os.path.dirname(dead), exist_ok=True)
+    shutil.copyfile(path, dead)
+    dataplane.mark_job_cancelled("jobdead")
+    with pytest.raises(IoError, match="cancelled"):
+        list(dataplane.fetch_partition_chunks(
+            "localhost", server.port, "jobdead", 1, 0, chunk_bytes=1024))
+
+
+def test_stream_fetch_decode_matches_whole_fetch(plane):
+    server, path, s = plane
+    whole = dataplane.fetch_partition_bytes(
+        "localhost", server.port, "jobs1", 1, 0)
+    chunks = dataplane.fetch_partition_chunks(
+        "localhost", server.port, "jobs1", 1, 0, chunk_bytes=4096)
+    n1, a1, _, d1, _ = ipc.read_partition_arrays(whole)
+    n2, a2, _, d2, _ = ipc.read_partition_arrays_from_chunks(chunks)
+    for name in n1:
+        assert np.array_equal(a1[name], a2[name]), name
+
+
+def test_chunk_cancel_aborts_inflight_transfer(plane, monkeypatch):
+    """The reader loop checks the cancel token at every chunk boundary:
+    a token fired mid-transfer stops the fetch within one chunk instead
+    of draining the stream."""
+    server, path, _ = plane
+    token = CancelToken()
+    got = []
+    with bind_token(token):
+        from ballista_tpu.lifecycle import check_cancel
+
+        with pytest.raises(QueryCancelled):
+            for chunk in dataplane.fetch_partition_chunks(
+                    "localhost", server.port, "jobs1", 1, 0,
+                    chunk_bytes=1024, window_bytes=2048):
+                check_cancel()
+                got.append(chunk)
+                if len(got) == 2:
+                    token.cancel("test")
+    raw_len = os.path.getsize(path)
+    assert sum(len(c) for c in got) < raw_len, "fetch ran to completion"
+
+
+# ---------------------------------------------------------------------------
+# e2e: spill-forced vs spill-free determinism on both paths
+# ---------------------------------------------------------------------------
+
+
+def _tpch_ctx_standalone(data_dir):
+    import sys
+
+    sys.path.insert(0, REPO)
+    from benchmarks.tpch.schema_def import register_tpch
+
+    ctx = BallistaContext.standalone()
+    register_tpch(ctx, data_dir, "tbl")
+    return ctx
+
+
+@pytest.fixture(scope="module")
+def tpch_dir(tmp_path_factory):
+    import sys
+
+    sys.path.insert(0, REPO)
+    from benchmarks.tpch import datagen
+
+    d = str(tmp_path_factory.mktemp("tpch_spill"))
+    datagen.generate(d, scale=0.01, num_parts=2)
+    return d
+
+
+def _assert_frames_identical(got: pd.DataFrame, exp: pd.DataFrame):
+    assert list(got.columns) == list(exp.columns)
+    assert len(got) == len(exp)
+    for name in exp.columns:
+        assert np.array_equal(got[name].to_numpy(), exp[name].to_numpy()), \
+            f"column {name} differs"
+
+
+@pytest.mark.parametrize("qname", ["q5", "q16"])
+def test_spill_on_off_byte_identical(tpch_dir, tmp_path, monkeypatch,
+                                     qname):
+    """The acceptance gate: a spill-FORCED cluster run (tiny budget,
+    every fetched chunk streamed from disk) produces byte-identical
+    results to the spill-free run, and the standalone path under the
+    same knobs matches both."""
+    monkeypatch.setattr(ShuffleReaderExec, "FORCE_REMOTE", True)
+    monkeypatch.setenv("BALLISTA_SHUFFLE_SPILL_DIR", str(tmp_path / "sp"))
+    spill._reset_pool()
+    sql = open(os.path.join(REPO, "benchmarks", "tpch", "queries",
+                            f"{qname}.sql")).read()
+    gov = spill.governor()
+
+    def cluster_run():
+        import sys
+
+        sys.path.insert(0, REPO)
+        from benchmarks.tpch.schema_def import register_tpch
+
+        cluster = LocalCluster(num_executors=2, concurrent_tasks=2)
+        try:
+            ctx = BallistaContext("remote", "localhost", cluster.port,
+                                  settings={"job.timeout": "120"})
+            register_tpch(ctx, tpch_dir, "tbl")
+            return ctx.sql(sql).collect()
+        finally:
+            cluster.shutdown()
+
+    # spill-free: budget far above the workload
+    monkeypatch.setenv("BALLISTA_SHUFFLE_MEM_BUDGET", str(1 << 30))
+    free = cluster_run()
+    spilled0 = gov.stats()["spilled_bytes_total"]
+
+    # spill-forced: floor budget + tiny chunks -> disk lane engaged
+    monkeypatch.setenv("BALLISTA_SHUFFLE_MEM_BUDGET", "4096")
+    monkeypatch.setenv("BALLISTA_SHUFFLE_CHUNK_BYTES", "2048")
+    forced = cluster_run()
+    assert gov.stats()["spilled_bytes_total"] > spilled0, \
+        "tiny budget did not engage the spill lane"
+    assert gov.inflight_bytes == 0, "spill run leaked governed budget"
+    _assert_frames_identical(forced, free)
+
+    # standalone path under the same (tiny) knobs matches the cluster
+    alone = _tpch_ctx_standalone(tpch_dir).sql(sql).collect()
+    _assert_frames_identical(alone, free)
+    spill._reset_pool()
+
+
+def test_executors_table_carries_spill_columns():
+    ctx = BallistaContext.standalone()
+    rows = ctx.table("system.executors").collect()
+    assert "shuffle_inflight_bytes" in rows.columns
+    assert "spill_bytes_total" in rows.columns
+    assert int(rows["shuffle_inflight_bytes"].iloc[0]) >= 0
+
+
+# ---------------------------------------------------------------------------
+# overhead gate: knobs armed must not move warm q1 (drift-cancelling)
+# ---------------------------------------------------------------------------
+
+
+def test_spill_overhead_q1_under_5pct(tmp_path_factory, monkeypatch):
+    """Same drift-cancelling scheme as the other planes' gates: warm q1
+    with the spill knobs ARMED (budget/watermark/chunk set) vs unset.
+    The standalone hot path must not touch the governor at all, so any
+    measurable delta is a coupling regression."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    from benchmarks.tpch import datagen
+    from benchmarks.tpch.schema_def import register_tpch
+
+    data_dir = str(tmp_path_factory.mktemp("tpch_spill_ovh"))
+    datagen.generate(data_dir, scale=0.01, num_parts=1)
+    ctx = BallistaContext.standalone()
+    register_tpch(ctx, data_dir, "tbl")
+    qdir = os.path.join(REPO, "benchmarks", "tpch", "queries")
+    df = ctx.sql(open(os.path.join(qdir, "q1.sql")).read())
+    df.collect()  # warm: jit compile + table caches
+
+    def arm(on: bool):
+        for k, v in (("BALLISTA_SHUFFLE_MEM_BUDGET", str(64 << 20)),
+                     ("BALLISTA_SHUFFLE_CHUNK_BYTES", str(1 << 20)),
+                     ("BALLISTA_SHUFFLE_SPILL_WATERMARK", "0.5")):
+            if on:
+                monkeypatch.setenv(k, v)
+            else:
+                monkeypatch.delenv(k, raising=False)
+
+    def sample(on: bool) -> float:
+        arm(on)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            df.collect()
+        return time.perf_counter() - t0
+
+    sample(True)
+    sample(False)  # settle both paths before measuring
+
+    def measure():
+        offs, ons = [], []
+        for i in range(9):
+            if i % 2 == 0:
+                offs.append(sample(False))
+                ons.append(sample(True))
+            else:
+                ons.append(sample(True))
+                offs.append(sample(False))
+        return sorted(offs)[4], sorted(ons)[4]
+
+    for _ in range(3):
+        t_off, t_on = measure()
+        if t_on <= t_off * 1.05 + 2e-3:
+            return
+    overhead = (t_on - t_off) / t_off
+    raise AssertionError(
+        f"spill-knob overhead {overhead:.1%} over the 5% gate "
+        f"(off={t_off:.4f}s on={t_on:.4f}s)")
