@@ -1,0 +1,33 @@
+"""Unified AST static analysis for the engine's hand-enforced
+invariants.
+
+One framework (``engine.py``: parse-once package model, structured
+findings, ``# ballista: ignore[rule]`` suppressions, committed
+baseline; ``callgraph.py``: shared scope/import-resolving index), eight
+passes (``passes/``): three semantic rules for the bug classes review
+kept catching by hand — cancel-coverage, sync-span, lock-discipline —
+plus the five code-shape lints that previously lived as independent
+regex scripts under ``dev/``.
+
+Driven by ``dev/analyze.py`` (tier-1 runs it with
+``--baseline dev/analysis_baseline.json``); rule catalogue and
+workflows in docs/static_analysis.md.
+
+Import discipline: this package is stdlib-only at import time and uses
+only intra-package relative imports, so ``dev/analyze.py`` can load it
+WITHOUT executing ``ballista_tpu/__init__`` (which imports jax) — the
+pure-AST rules then run in milliseconds; only the registry-backed
+rules (metric-names, fault-points, knob-docs) import live engine
+modules, lazily, inside ``run``.
+"""
+
+from .engine import (  # noqa: F401
+    AnalysisResult,
+    Baseline,
+    Finding,
+    Package,
+    Rule,
+    analyze,
+    make_finding,
+)
+from .passes import RULE_FACTORIES, all_rules, rules_for  # noqa: F401

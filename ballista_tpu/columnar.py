@@ -342,10 +342,12 @@ class Column:
         numerics — integer columns with NULLs widen to float64, matching
         pandas conventions.
         """
-        vals = np.asarray(self.values)
-        invalid = None
-        if self.validity is not None:
-            invalid = ~np.asarray(self.validity)
+        from .observability.tracing import trace_span
+
+        with trace_span("device.block", site="column.to_numpy"):
+            vals = np.asarray(self.values)
+            invalid = (None if self.validity is None
+                       else ~np.asarray(self.validity))
         if row_mask is not None:
             vals = vals[row_mask]
             if invalid is not None:

@@ -100,8 +100,13 @@ def shuffle_path(work_dir: str, job_id: str, stage_id: int,
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    from ..lifecycle import check_cancel
+
     buf = bytearray()
     while len(buf) < n:
+        # a cancelled query stops pulling between recvs even mid-frame
+        # (no-op for server handler threads, which bind no token)
+        check_cancel()
         chunk = sock.recv(n - len(buf))
         if not chunk:
             raise IoError("data plane connection closed early")
@@ -159,6 +164,7 @@ def fetch_partition_chunks(host: str, port: int, job_id: str,
     generator), which IS the flow control: acks are sent only after the
     previous chunk was consumed, so a slow consumer idles the wire at
     ``window_bytes`` in flight, not at the partition size."""
+    from ..lifecycle import check_cancel
     from .spill import shuffle_chunk_bytes, stream_window_bytes
 
     window = int(window_bytes or stream_window_bytes())
@@ -181,6 +187,10 @@ def fetch_partition_chunks(host: str, port: int, job_id: str,
             (length,) = struct.unpack(">Q", _recv_exact(sock, 8))
             remaining = length
             while remaining > 0:
+                # chunk-level cancellation: a fired token aborts the
+                # fetch of a multi-GB legacy-framed body mid-transfer
+                # even when the consumer forgets to check
+                check_cancel()
                 chunk = _recv_exact(sock, min(piece, remaining))
                 remaining -= len(chunk)
                 yield chunk
@@ -188,6 +198,7 @@ def fetch_partition_chunks(host: str, port: int, job_id: str,
         if status != 2:
             raise IoError(f"bad data-plane status byte {status}")
         while True:
+            check_cancel()  # per-frame: cancel aborts mid-stream fetches
             (n,) = struct.unpack(">I", _recv_exact(sock, 4))
             if n == 0:
                 return
@@ -307,6 +318,9 @@ class _Handler(socketserver.BaseRequestHandler):
                     chunk = fh.read(piece)
                     if not chunk:
                         break
+                    # window-bounded ack drain; the enclosing per-chunk
+                    # loop re-checks the cancelled-job registry
+                    # ballista: ignore[cancel-coverage]
                     while unacked + len(chunk) > window and unacked > 0:
                         (acked,) = struct.unpack(
                             ">I", _recv_exact(sock, 4))

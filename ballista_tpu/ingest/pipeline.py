@@ -326,6 +326,7 @@ def prime_plan(phys, partitions: Optional[List[int]] = None) -> int:
     if prefetch_batches() <= 0:
         return 0
     from ..io.memory import MemTableSource
+    from ..lifecycle import check_cancel
 
     n = 0
     for scan in _iter_scans(phys):
@@ -336,6 +337,8 @@ def prime_plan(phys, partitions: Optional[List[int]] = None) -> int:
             p for p in partitions if 0 <= p < nparts
         ]
         for p in parts:
+            # an already-cancelled query must not fan out N prefetches
+            check_cancel()
             if scan.prime(p) is not None:
                 n += 1
     if n:

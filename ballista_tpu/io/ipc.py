@@ -76,16 +76,36 @@ def _arrow():
 
 
 def batch_to_arrow(batch: ColumnBatch):
-    """Compact a ColumnBatch to a pyarrow RecordBatch (live rows only)."""
+    """Compact a ColumnBatch to a pyarrow RecordBatch (live rows only).
+
+    Every D2H fetch (selection, then each column's buffers) runs under
+    a ``device.block`` span, so shuffle-write sync time lands in the
+    profiler's ``device_blocked`` lane instead of hiding inside the
+    write lane. Fetches stay per-column (not one batched hoist): at
+    most ONE full-capacity host copy is live beside the masked
+    outputs, the pre-span memory shape."""
     pa = _arrow()
-    mask = np.asarray(batch.selection)
+    from ..observability.tracing import trace_span
+
+    with trace_span("device.block", site="ipc.batch_to_arrow"):
+        mask = np.asarray(batch.selection)
     arrays = []
     fields = []
+    # bounded per-batch column conversion; the chunk loop in
+    # write_arrow carries the cancel check
+    # ballista: ignore[cancel-coverage]
     for f, col in zip(batch.schema.fields, batch.columns):
-        vals = np.asarray(col.values)[mask]
+        with trace_span("device.block", site="ipc.batch_to_arrow",
+                        col=f.name):
+            hv = np.asarray(col.values)
+            hval = (None if col.validity is None
+                    else np.asarray(col.validity))
+        vals = hv[mask]
+        del hv
         nulls = None
-        if col.validity is not None:
-            nulls = ~np.asarray(col.validity)[mask]
+        if hval is not None:
+            nulls = ~hval[mask]
+        del hval
         meta = {b"ballista.kind": f.dtype.kind.encode(),
                 b"ballista.scale": str(f.dtype.scale).encode()}
         if f.dtype.kind == "utf8":
@@ -160,6 +180,9 @@ class _ColumnStatsAcc:
                        "codes": set(), "first_dict": None, "multi": False}
                 for name in rb.schema.names
             }
+        # bounded per-record-batch stats merge; callers' chunk loops
+        # carry the cancel check
+        # ballista: ignore[cancel-coverage]
         for i, name in enumerate(rb.schema.names):
             st = self._cols[name]
             col = rb.column(i)
@@ -320,9 +343,15 @@ def write_partition(path: str, batches: List[ColumnBatch],
     turns it off: per-file column stats there have no consumer and a
     64-way shuffle would pay 64 stat passes per task). Thin list-based
     wrapper over :class:`PartitionWriter`."""
+    from ..lifecycle import check_cancel
+
     w = PartitionWriter(path, compute_column_stats=compute_column_stats)
     try:
         for b in batches:
+            # batch-level cancellation on top of write_arrow's
+            # chunk-level checks (w is dynamic, so the analyzer cannot
+            # follow the call)
+            check_cancel()
             w.write_batch(b)
         return w.close()
     except BaseException:
@@ -437,11 +466,17 @@ def read_partition_arrays_from_chunks(chunks: Iterable[bytes]):
 
 
 def _batch_iter(reader):
+    from ..lifecycle import check_cancel
+
     if hasattr(reader, "num_record_batches"):  # legacy FILE format
         for i in range(reader.num_record_batches):
+            # per-record-batch cancellation at the producer, so every
+            # consumer of this iterator inherits it
+            check_cancel()
             yield reader.get_batch(i)
         return
     while True:
+        check_cancel()
         try:
             rb = reader.read_next_batch()
         except StopIteration:
@@ -631,6 +666,8 @@ def batches_from_parts(
 def _batches_from_parts_inner(schema, parts, capacity, jnp):
     # union dictionaries per utf8 column — split from batches_from_parts
     # only so the shuffle-byte accounting brackets the whole assembly
+    from ..lifecycle import check_cancel
+
     union_dicts: Dict[str, Dictionary] = {}
     remaps: Dict[str, List[np.ndarray]] = {}
     for f in schema.fields:
@@ -641,6 +678,9 @@ def _batches_from_parts_inner(schema, parts, capacity, jnp):
             remaps[f.name] = remapped
     out = []
     for pi, (arrays, nulls, dicts) in enumerate(parts):
+        # per-part cancellation: assembly pads + uploads every part
+        # (H2D), real work a fired token must be able to stop
+        check_cancel()
         n = len(next(iter(arrays.values()))) if arrays else 0
         # shuffle-read batches enter at canonical ladder capacities:
         # unevenly-sized shuffle partitions share compiled signatures

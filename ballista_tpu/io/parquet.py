@@ -232,10 +232,15 @@ class ParquetSource(TableSource):
                     arrays[name] = arr.to_numpy(
                         zero_copy_only=False).astype(
                             field.dtype.device_dtype())
+        from ..lifecycle import check_cancel
+
         cap = min(self._capacity, bucket_capacity(max(n, 1)))
         start = 0
         emitted = False
         while start < n or not emitted:
+            # chunk-level cancellation: each iteration slices + uploads
+            # one batch, the boundary a fired token stops at
+            check_cancel()
             end = min(start + cap, n)
             chunk = {k: v[start:end] for k, v in arrays.items()}
             vchunk = (

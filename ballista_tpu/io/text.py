@@ -423,10 +423,15 @@ class DelimitedSource(TableSource):
                             valids=None, force_emit=True):
         # scan batches enter at canonical ladder capacities so uneven
         # files/partitions reuse a handful of compiled signatures
+        from ..lifecycle import check_cancel
+
         cap = min(self._capacity, bucket_capacity(max(n, 1)))
         start = 0
         emitted = not force_emit
         while start < n or not emitted:
+            # chunk-level cancellation: each iteration slices + uploads
+            # one batch, the boundary a fired token stops at
+            check_cancel()
             end = min(start + cap, n)
             chunk = {k: v[start:end] for k, v in arrays.items()}
             vchunk = (

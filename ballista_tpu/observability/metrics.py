@@ -129,7 +129,11 @@ class MetricsSet:
         try:
             import jax
 
-            counts = jax.device_get(pending)  # one transfer for them all
+            from .tracing import trace_span
+
+            with trace_span("device.block", site="metrics.rows",
+                            n=len(pending)):
+                counts = jax.device_get(pending)  # one transfer for all
         except Exception:  # noqa: BLE001 - already-host scalars
             counts = pending
         self._counters["output_rows"] = (
@@ -159,7 +163,13 @@ class MetricsSet:
             try:
                 import jax
 
-                counts = jax.device_get(ready)
+                from .tracing import trace_span
+
+                # ready scalars only — the transfer is tiny, but it IS
+                # a sync; spanning keeps the lane sum exact
+                with trace_span("device.block", site="metrics.rows",
+                                n=len(ready)):
+                    counts = jax.device_get(ready)
             except Exception:  # noqa: BLE001 - already-host scalars
                 counts = ready
             try:
@@ -285,7 +295,11 @@ def resolve_all_pending(metrics_sets: Iterable[MetricsSet]) -> None:
     try:
         import jax
 
-        counts = jax.device_get(pending)
+        from .tracing import trace_span
+
+        with trace_span("device.block", site="metrics.rows",
+                        n=len(pending)):
+            counts = jax.device_get(pending)
     except Exception:  # noqa: BLE001 - already-host scalars
         counts = pending
     i = 0

@@ -191,11 +191,15 @@ def set_process_identity(role: str, executor_id: Optional[str] = None
     LocalCluster the scheduler and executors share one tracer, and
     executor records are re-tagged at per-task window extraction
     instead (observability/distributed.py)."""
-    if _identity:
-        return
-    _identity["role"] = role
-    if executor_id:
-        _identity["exec"] = executor_id[:8]
+    with _lock:
+        # under the lock, "first writer wins" is exact: two concurrent
+        # claimants (executor start racing a scheduler start in one
+        # LocalCluster process) can no longer interleave role/exec
+        if _identity:
+            return
+        _identity["role"] = role
+        if executor_id:
+            _identity["exec"] = executor_id[:8]
 
 
 def process_identity() -> dict:

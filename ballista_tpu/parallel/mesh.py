@@ -51,12 +51,18 @@ def _stack_batches(schema: Schema, batches: List[ColumnBatch]):
     caps = {b.capacity for b in batches}
     if len(caps) != 1:
         raise ExecutionError(f"device batches must share capacity, got {caps}")
+    from ..observability.tracing import trace_span
+
     cols = {}
-    for i, f in enumerate(schema.fields):
-        cols[f.name] = np.stack(
-            [np.asarray(b.columns[i].values) for b in batches]
-        )
-    sel = np.stack([np.asarray(b.selection) for b in batches])
+    # the relayout round-trips every column through host memory — a
+    # real blocking sync the profiler must attribute to device time
+    with trace_span("device.block", site="mesh.stack",
+                    n=len(batches)):
+        for i, f in enumerate(schema.fields):
+            cols[f.name] = np.stack(
+                [np.asarray(b.columns[i].values) for b in batches]
+            )
+        sel = np.stack([np.asarray(b.selection) for b in batches])
     dicts = {
         f.name: batches[0].columns[i].dictionary
         for i, f in enumerate(schema.fields)

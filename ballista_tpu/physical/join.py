@@ -474,7 +474,10 @@ class JoinExec(PhysicalPlan):
                                                   bkeys, blive))
         # selection, not blive: build rows with NULL join keys can never
         # match but SQL still emits them with null probe columns
-        unmatched = np.asarray(build_batch.selection) & ~hit
+        from ..observability import trace_span
+
+        with trace_span("device.block", site="join.unmatched"):
+            unmatched = np.asarray(build_batch.selection) & ~hit
         yield self._unmatched_build_batch(build_batch, jnp.asarray(unmatched))
 
     def _mark_hits(self, build_batch, pb, mode, key_tables, remaps,

@@ -251,8 +251,12 @@ def assemble_over_mesh(producer, schema: Schema, mesh
     else:
         # ONE batched fetch for all slot counts: sequential int() reads
         # would pay a device->host round-trip per device
-        counts = [int(c) for c in jax.device_get(
-            [slot_bigs[i].num_rows for i in local_slots])]
+        from ..observability.tracing import trace_span
+
+        with trace_span("device.block", site="mesh.input_counts",
+                        n=len(local_slots)):
+            counts = [int(c) for c in jax.device_get(
+                [slot_bigs[i].num_rows for i in local_slots])]
         cap = bucket_capacity(max(max(counts), 1))
     slot_batches = [_compact_to(slot_bigs[i], cap=cap)
                     for i in local_slots]

@@ -497,11 +497,18 @@ class RepartitionExec(PhysicalPlan):
                          for b, (perm, _), c in zip(batches, pairs,
                                                     resolved)]
             else:
+                from ..observability import trace_span
+
                 parts = []
                 offset = 0
                 for batch in batches:
                     perm, counts = mask_fn(batch, jnp.int32(offset))
-                    parts.append((batch, perm, np.asarray(counts)))
+                    # offset-dependent batches serialize: one sync per
+                    # batch, each attributed to the blocked lane
+                    with trace_span("device.block", site="repart.counts",
+                                    n=1):
+                        host_counts = np.asarray(counts)
+                    parts.append((batch, perm, host_counts))
                     offset += batch.num_rows_host()
             self._parts = parts
         return self._parts

@@ -189,6 +189,8 @@ class ShuffleReaderExec(PhysicalPlan):
         carrying that producer's TOTAL stats, so counting distinct
         producers once is what is exact."""
         seen = {}
+        # metadata walk over location stats, no per-iteration IO
+        # ballista: ignore[cancel-coverage]
         for loc in self.partition_locations:
             n = (loc.stats or {}).get("num_rows")
             if n is None:

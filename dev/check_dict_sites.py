@@ -1,21 +1,13 @@
 #!/usr/bin/env python
 """Guard against host dictionary unify/remap paths regrowing outside
-the dictionary registry.
+the dictionary registry — thin shim over the unified analysis engine
+(``ballista_tpu/analysis/``, rule id ``dict-sites``; run everything at
+once with ``dev/analyze.py``).
 
-ISSUE 11 moved every sorted-union / searchsorted-remap over dictionary
-value arrays into ``ballista_tpu/columnar_registry.py`` (versioned
-entries, cached integer remaps) and ``ballista_tpu/columnar.py``
-(the Dictionary's own encode primitives). A stray ``np.unique(`` /
-``np.searchsorted(`` anywhere else silently reintroduces the
-GIL-bound object-array work the ``host.dictionary`` profiler lane
-exists to keep visible — this lint (run from tier-1,
-tests/test_dict_registry.py) fails the build instead, mirroring
-``dev/check_jit_sites.py``.
-
-Device-side ``jnp.searchsorted`` is fine (that's the point); only host
-``np.`` calls are flagged. Legitimate non-dictionary uses elsewhere
-(building a NEW dictionary from raw scan values, numeric arrays) opt
-out per line with a trailing ``# dict-ok: <reason>`` marker.
+CLI and exit semantics are unchanged from the standalone version:
+exit 0 = clean, per-site ``DICT-SITE:`` lines on stderr otherwise.
+Per-line opt-out stays ``# dict-ok: <reason>``; the allowlist lives on
+the rule (``analysis/passes/shape.py::DictSitesRule``).
 
 Usage: python dev/check_dict_sites.py   (exit 0 = clean)
 """
@@ -23,51 +15,26 @@ Usage: python dev/check_dict_sites.py   (exit 0 = clean)
 from __future__ import annotations
 
 import os
-import re
 import sys
 from typing import List, Tuple
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-PKG = os.path.join(HERE, "..", "ballista_tpu")
+REPO = os.path.normpath(os.path.join(HERE, ".."))
+sys.path.insert(0, HERE)
 
-# repo-relative files allowed to run host unique/searchsorted directly
-ALLOWLIST = {
-    # THE unify/remap site: versioned unions, cached remap tables
-    "ballista_tpu/columnar_registry.py",
-    # the Dictionary's own encode/canonicalize/search primitives —
-    # building a dictionary from raw values is not unifying two
-    "ballista_tpu/columnar.py",
-}
-
-MARKER = "dict-ok:"
-
-_PAT = re.compile(r"\bnp\s*\.\s*(?:unique|searchsorted)\s*\(")
-_COMMENT = re.compile(r"(^|\s)#.*$")
+import analyze  # noqa: E402 - sibling loader for the analysis engine
 
 
 def scan() -> List[Tuple[str, int, str]]:
-    hits: List[Tuple[str, int, str]] = []
-    for root, _dirs, files in os.walk(os.path.abspath(PKG)):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            rel = os.path.relpath(
-                path, os.path.abspath(os.path.join(HERE, ".."))
-            ).replace(os.sep, "/")
-            if rel in ALLOWLIST:
-                continue
-            in_doc = False
-            for i, line in enumerate(open(path, encoding="utf-8"), 1):
-                if line.count('"""') % 2 == 1:
-                    in_doc = not in_doc
-                    continue
-                if in_doc or MARKER in line:
-                    continue
-                code = _COMMENT.sub("", line)
-                if _PAT.search(code):
-                    hits.append((rel, i, line.rstrip()))
-    return hits
+    analysis = analyze.load_analysis(REPO)
+    pkg = analysis.Package.load(REPO)
+    rule = analysis.RULE_FACTORIES["dict-sites"]()
+    result = analysis.analyze(pkg, [rule])
+    # unparseable files fail too: the regex original scanned raw text,
+    # so a violation in a broken file could never pass silently
+    return [(f.file, f.line, f.message) for f in result.parse_errors] + \
+        [(f.file, f.line, pkg.by_rel[f.file].line(f.line).rstrip())
+         for f in result.findings]
 
 
 def main() -> int:

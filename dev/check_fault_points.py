@@ -1,20 +1,13 @@
 #!/usr/bin/env python
-"""Fault-point drift lint (tier-1).
+"""Fault-point drift lint (tier-1) — thin shim over the unified
+analysis engine (``ballista_tpu/analysis/``, rule id ``fault-points``;
+run everything at once with ``dev/analyze.py``).
 
-Every ``fault_point("x", ...)`` literal in ``ballista_tpu/**`` must
-name a point registered in
-``ballista_tpu/testing/faults.py::FAULT_POINTS`` — the same table
-``BALLISTA_FAULTS`` validates specs against and docs/robustness.md
-catalogs. A call site that builds its name dynamically must carry a
-``# fault-points: a b c`` annotation on the same line naming every
-point it can hit; those names are checked against the registry too.
-
-The check is symmetric: a registered point with NO call site fails as
-well — a fault the chaos sweep can arm but that can never fire is a
-test bug waiting to no-op.
-
-Wired into tier-1 (tests/test_lifecycle.py) next to
-check_metric_names.py / check_knob_docs.py / check_proto_sync.py.
+CLI and exit semantics are unchanged from the standalone version:
+exit 0 = in sync, per-problem ``error:`` lines otherwise. The check
+stays symmetric — unknown call-site names AND registered points with
+no call site both fail. Dynamic sites still annotate with
+``# fault-points: a b c``.
 
 Usage: python dev/check_fault_points.py   (exit 0 = clean)
 """
@@ -22,96 +15,29 @@ Usage: python dev/check_fault_points.py   (exit 0 = clean)
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import Dict, List, Set, Tuple
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-ROOT = os.path.abspath(os.path.join(HERE, ".."))
-PKG = os.path.join(ROOT, "ballista_tpu")
+REPO = os.path.normpath(os.path.join(HERE, ".."))
+sys.path.insert(0, HERE)
 
-sys.path.insert(0, ROOT)
-
-from ballista_tpu.testing.faults import FAULT_POINTS  # noqa: E402
-
-_CALL = re.compile(r"\bfault_point\s*\(")
-# a literal first argument ends at , or ) — "prefix." + name is DYNAMIC
-_LITERAL_ARG = re.compile(r"^\s*(['\"])([^'\"]+)\1\s*[,)]")
-_ANNOTATION = re.compile(r"#\s*fault-points:\s*([\w\s.,-]+)")
-
-# the machinery itself (definitions, re-dispatch) — not call sites
-SKIP_FILES = {
-    "ballista_tpu/testing/faults.py",
-}
-SKIP_DIRS = ("ballista_tpu/proto/",)
-
-
-def scan() -> Tuple[List[Tuple[str, int, str, str]], Dict[str, int]]:
-    """Returns (problems, {point: call-site count})."""
-    problems: List[Tuple[str, int, str, str]] = []
-    used: Dict[str, int] = {p: 0 for p in FAULT_POINTS}
-    for root, dirs, files in os.walk(PKG):
-        dirs[:] = [d for d in dirs if d != "__pycache__"]
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            rel = os.path.relpath(path, ROOT).replace(os.sep, "/")
-            if rel in SKIP_FILES or rel.startswith(SKIP_DIRS):
-                continue
-            for i, line in enumerate(open(path, encoding="utf-8"), 1):
-                dynamic = False
-                for m in _CALL.finditer(line):
-                    lit = _LITERAL_ARG.match(line[m.end():])
-                    if lit is None:
-                        dynamic = True
-                        continue
-                    name = lit.group(2)
-                    if name in FAULT_POINTS:
-                        used[name] += 1
-                    else:
-                        problems.append(
-                            (rel, i, name,
-                             "literal fault-point name not in "
-                             "FAULT_POINTS registry"))
-                if dynamic:
-                    ann = _ANNOTATION.search(line)
-                    if ann is None:
-                        problems.append(
-                            (rel, i, "<dynamic>",
-                             "dynamic fault-point name without a "
-                             "'# fault-points: ...' annotation"))
-                        continue
-                    names: Set[str] = {
-                        t for t in re.split(r"[\s,]+", ann.group(1))
-                        if t
-                    }
-                    for name in sorted(names):
-                        if name in FAULT_POINTS:
-                            used[name] += 1
-                        else:
-                            problems.append(
-                                (rel, i, name,
-                                 "annotated fault-point name not in "
-                                 "FAULT_POINTS registry"))
-    return problems, used
+import analyze  # noqa: E402 - sibling loader for the analysis engine
 
 
 def main() -> int:
-    problems, used = scan()
-    for rel, line, name, why in problems:
-        print(f"error: {rel}:{line}: {name!r}: {why}")
-    unused = sorted(p for p, n in used.items() if n == 0)
-    for p in unused:
-        print(f"error: registered fault point {p!r} has no call site "
-              "(an armable fault that can never fire)")
-    n = len(problems) + len(unused)
-    if n:
-        print(f"{n} fault-point drift error(s)")
+    analysis = analyze.load_analysis(REPO)
+    pkg = analysis.Package.load(REPO)
+    rule = analysis.RULE_FACTORIES["fault-points"]()
+    result = analysis.analyze(pkg, [rule])
+    problems = result.parse_errors + result.findings
+    if problems:
+        for f in problems:
+            print(f"error: {f.file}:{f.line}: {f.message}")
+        print(f"{len(problems)} fault-point drift error(s)")
         return 1
-    total = sum(used.values())
-    print(f"fault points in sync ({len(used)} registered, "
-          f"{total} call site(s))")
+    from ballista_tpu.testing.faults import FAULT_POINTS
+
+    print(f"fault points in sync ({len(FAULT_POINTS)} registered)")
     return 0
 
 

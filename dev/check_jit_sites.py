@@ -1,18 +1,14 @@
 #!/usr/bin/env python
 """Guard against raw ``jax.jit`` call sites regrowing outside the
-compile governor.
+compile governor — thin shim over the unified analysis engine
+(``ballista_tpu/analysis/``, rule id ``jit-sites``; run everything at
+once with ``dev/analyze.py``).
 
-PR 3 folded ~10 scattered ad-hoc jit caches (per-instance
-``self._jit_cache`` dicts, module-level ``*_JITS`` maps) into
-``ballista_tpu/compile/`` so compilation is a managed, observable
-resource: adaptive re-plans reuse traces, compile counts/seconds flow
-into operator metrics, and shape bucketing bounds the signature count.
-A stray ``jax.jit(`` anywhere else silently re-creates the
-uncounted-per-instance-cache problem — this lint (run from tier-1,
-tests/test_compile_governor.py) fails the build instead.
-
-Scans ``ballista_tpu/**/*.py`` for ``jax.jit`` / ``pjit`` uses. The
-allowlist names the legitimate remainder (the governor itself).
+CLI and exit semantics are unchanged from the standalone version:
+exit 0 = clean, per-site ``JIT-SITE:`` lines on stderr otherwise, and
+``--budget`` still runs the program-count regression gate. Per-line
+opt-out stays ``# jit-ok: <reason>``; the allowlist lives on the rule
+(``analysis/passes/shape.py::JitSitesRule``).
 
 Usage: python dev/check_jit_sites.py   (exit 0 = clean)
 """
@@ -20,57 +16,28 @@ Usage: python dev/check_jit_sites.py   (exit 0 = clean)
 from __future__ import annotations
 
 import os
-import re
 import sys
 from typing import List, Tuple
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-PKG = os.path.join(HERE, "..", "ballista_tpu")
+REPO = os.path.normpath(os.path.join(HERE, ".."))
+sys.path.insert(0, HERE)
 
-# repo-relative files allowed to call jax.jit directly
-ALLOWLIST = {
-    "ballista_tpu/compile/governor.py",  # THE jit site: the governor
-    # fused-stage AOT export wraps a governed entry's own (already
-    # governed) python function for jax.export serialization — it never
-    # creates an uncounted cache
-    "ballista_tpu/compile/aot.py",
-}
-
-# individual call sites elsewhere opt out with a trailing
-# ``# jit-ok: <reason>`` comment on the offending line — file-level
-# allowlisting would silently exempt future sites in the same module
-MARKER = "jit-ok:"
-
-# jax.jit(...), jax.pjit(...), bare pjit( after a from-import
-_PAT = re.compile(r"\bjax\s*\.\s*(?:jit|pjit)\s*\(|\bpjit\s*\(")
-_COMMENT = re.compile(r"(^|\s)#.*$")
+import analyze  # noqa: E402 - sibling loader for the analysis engine
 
 
 def scan() -> List[Tuple[str, int, str]]:
-    hits: List[Tuple[str, int, str]] = []
-    for root, _dirs, files in os.walk(os.path.abspath(PKG)):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            rel = os.path.relpath(
-                path, os.path.abspath(os.path.join(HERE, ".."))
-            ).replace(os.sep, "/")
-            if rel in ALLOWLIST:
-                continue
-            in_doc = False
-            for i, line in enumerate(open(path, encoding="utf-8"), 1):
-                # crude but sufficient: strip comments; skip docstring
-                # bodies (module docs MENTION jax.jit legitimately)
-                if line.count('"""') % 2 == 1:
-                    in_doc = not in_doc
-                    continue
-                if in_doc or MARKER in line:
-                    continue
-                code = _COMMENT.sub("", line)
-                if _PAT.search(code):
-                    hits.append((rel, i, line.rstrip()))
-    return hits
+    """[(repo-relative file, line, source line)] of violations —
+    signature preserved for tests importing this module directly."""
+    analysis = analyze.load_analysis(REPO)
+    pkg = analysis.Package.load(REPO)
+    rule = analysis.RULE_FACTORIES["jit-sites"]()
+    result = analysis.analyze(pkg, [rule])
+    # unparseable files fail too: the regex original scanned raw text,
+    # so a violation in a broken file could never pass silently
+    return [(f.file, f.line, f.message) for f in result.parse_errors] + \
+        [(f.file, f.line, pkg.by_rel[f.file].line(f.line).rstrip())
+         for f in result.findings]
 
 
 # ---------------------------------------------------------------------------
@@ -93,7 +60,7 @@ def check_budget(budget: int = DEFAULT_ENTRY_BUDGET) -> int:
     os.environ["BALLISTA_FUSION"] = "on"
     import tempfile
 
-    sys.path.insert(0, os.path.abspath(os.path.join(HERE, "..")))
+    sys.path.insert(0, REPO)
     from benchmarks.tpch import datagen
     from benchmarks.tpch.schema_def import register_tpch
     from ballista_tpu.client import BallistaContext

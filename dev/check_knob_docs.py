@@ -1,143 +1,46 @@
 #!/usr/bin/env python
-"""Knob/documentation drift lint (tier-1).
+"""Knob/documentation drift lint (tier-1) — thin shim over the unified
+analysis engine (``ballista_tpu/analysis/``, rule id ``knob-docs``;
+run everything at once with ``dev/analyze.py``).
 
 Three surfaces must agree on the set of ``BALLISTA_*`` environment
-knobs:
-
-- the SOURCE: every exact ``"BALLISTA_X"`` string literal in
-  ``ballista_tpu/**/*.py`` (AST string constants, so prose mentioning a
-  knob inside a docstring only counts when it IS the bare name);
-- the REGISTRY: ``observability/systables.py`` ``KNOBS`` /
-  ``KNOB_PREFIXES`` — what ``system.settings`` serves;
-- the DOCS: the README knob tables (any ``BALLISTA_X`` token).
-
-Failures are symmetric: a knob read in the source but missing from the
-registry or README fails, and so does a registry/README entry no code
-reads (stale docs). Dynamic env-name families (``BALLISTA_ADAPTIVE_*``,
-binary config prefixes) are declared as prefixes in ``KNOB_PREFIXES``;
-a literal ending in ``_`` must be one of them, and a README token is
-accepted when a declared prefix covers it.
+knobs — the source literals, the ``system.settings`` registry
+(``observability/systables.py`` KNOBS/KNOB_PREFIXES) and the README
+knob tables — with symmetric failures in every direction. CLI and exit
+semantics are unchanged from the standalone version: exit 0 = in sync,
+per-problem ``error:`` lines on stderr otherwise.
 
 Usage: python dev/check_knob_docs.py   (exit 0 = in sync)
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
-from typing import Dict, Set
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.normpath(os.path.join(HERE, ".."))
-PKG = os.path.join(REPO, "ballista_tpu")
-README = os.path.join(REPO, "README.md")
+sys.path.insert(0, HERE)
 
-_EXACT = re.compile(r"^BALLISTA_[A-Z0-9]+(?:_[A-Z0-9]+)*$")
-_PREFIX = re.compile(r"^BALLISTA_[A-Z0-9]+(?:_[A-Z0-9]+)*_$")
-_README_TOKEN = re.compile(r"\bBALLISTA_[A-Z0-9_]+\b")
-
-# literals that are not knobs: "BALLISTA_" alone is the base of a
-# dynamically-composed env name (adaptive/config.py, distributed/
-# config.py) — the composed families are declared as prefixes
-_IGNORED_LITERALS = {"BALLISTA_"}
-
-
-def source_literals() -> Dict[str, Set[str]]:
-    """{exact | prefix: {file:line, ...}} for every BALLISTA_* string
-    constant in the package."""
-    found: Dict[str, Set[str]] = {}
-    for dirpath, dirnames, filenames in os.walk(PKG):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, REPO)
-            try:
-                tree = ast.parse(open(path).read(), filename=path)
-            except SyntaxError as e:
-                print(f"error: cannot parse {rel}: {e}", file=sys.stderr)
-                sys.exit(2)
-            for node in ast.walk(tree):
-                if isinstance(node, ast.Constant) and \
-                        isinstance(node.value, str):
-                    v = node.value
-                    if v in _IGNORED_LITERALS:
-                        continue
-                    if _EXACT.match(v) or _PREFIX.match(v):
-                        found.setdefault(v, set()).add(
-                            f"{rel}:{node.lineno}")
-    return found
-
-
-def readme_tokens() -> Set[str]:
-    return set(_README_TOKEN.findall(open(README).read()))
+import analyze  # noqa: E402 - sibling loader for the analysis engine
 
 
 def main() -> int:
-    sys.path.insert(0, REPO)
+    analysis = analyze.load_analysis(REPO)
+    pkg = analysis.Package.load(REPO)
+    rule = analysis.RULE_FACTORIES["knob-docs"]()
+    result = analysis.analyze(pkg, [rule])
+    problems = result.parse_errors + result.findings
+    if problems:
+        for f in problems:
+            print(f"error: {f.message}", file=sys.stderr)
+        print(f"{len(problems)} knob/doc drift error(s)",
+              file=sys.stderr)
+        return 1
     from ballista_tpu.observability.systables import KNOB_PREFIXES, KNOBS
 
-    prefixes = set(KNOB_PREFIXES)
-    registry = set(KNOBS)
-    errors = []
-
-    def covered_by_prefix(name: str) -> bool:
-        return any(name.startswith(p) for p in prefixes)
-
-    literals = source_literals()
-    exact_in_source = {n for n in literals if not n.endswith("_")}
-    prefix_in_source = {n for n in literals if n.endswith("_")}
-
-    # 1. source -> registry
-    for name in sorted(exact_in_source):
-        if name not in registry and not covered_by_prefix(name):
-            where = ", ".join(sorted(literals[name])[:3])
-            errors.append(
-                f"knob {name} is read in the source ({where}) but "
-                "missing from the system.settings registry "
-                "(observability/systables.py KNOBS)")
-    for name in sorted(prefix_in_source):
-        if name not in prefixes:
-            where = ", ".join(sorted(literals[name])[:3])
-            errors.append(
-                f"dynamic knob prefix {name} is used in the source "
-                f"({where}) but not declared in KNOB_PREFIXES")
-
-    # 2. registry -> source (stale entries) and registry -> README
-    tokens = readme_tokens()
-    for name in sorted(registry):
-        if name not in exact_in_source:
-            errors.append(
-                f"registry knob {name} is not read anywhere in "
-                "ballista_tpu/ (stale KNOBS entry?)")
-        if name not in tokens:
-            errors.append(
-                f"registry knob {name} is missing from the README "
-                "knob tables")
-    for name in sorted(prefixes):
-        if name not in prefix_in_source:
-            errors.append(
-                f"declared prefix {name} is not used anywhere in "
-                "ballista_tpu/ (stale KNOB_PREFIXES entry?)")
-
-    # 3. README -> registry
-    for tok in sorted(tokens):
-        if tok in registry or covered_by_prefix(tok):
-            continue
-        errors.append(
-            f"README mentions {tok}, which is neither a registered "
-            "knob nor covered by a declared prefix")
-
-    if errors:
-        for e in errors:
-            print(f"error: {e}", file=sys.stderr)
-        print(f"{len(errors)} knob/doc drift error(s)", file=sys.stderr)
-        return 1
-    print(f"knob docs in sync ({len(registry)} knobs, "
-          f"{len(prefixes)} prefixes)")
+    print(f"knob docs in sync ({len(KNOBS)} knobs, "
+          f"{len(KNOB_PREFIXES)} prefixes)")
     return 0
 
 

@@ -1,19 +1,13 @@
 #!/usr/bin/env python
-"""Guard against metric names drifting out of the registry.
+"""Guard against metric names drifting out of the registry — thin shim
+over the unified analysis engine (``ballista_tpu/analysis/``, rule id
+``metric-names``; run everything at once with ``dev/analyze.py``).
 
-Every ``add_counter("x")`` / ``add_time("x")`` / ``set_gauge("x")``
-literal in ``ballista_tpu/**`` must name a metric registered in
-``ballista_tpu/observability/registry.py::OPERATOR_METRICS`` — the same
-table that gives the health plane its ``/metrics`` HELP/TYPE lines and
-documents every name in docs/observability.md. A call site that builds
-its name dynamically (e.g. ``add_time("elapsed_" + name, ...)``) must
-carry a ``# metric-names: a b c`` annotation on the same line naming
-every metric it can emit; those names are checked against the registry
-too. Prometheus family literals passed to health-plane samples
-(``("ballista_...", ...)``) are checked against ``PROCESS_METRICS``.
-
-Wired into tier-1 (tests/test_profiler_health.py) next to
-check_jit_sites.py / check_proto_sync.py.
+CLI and exit semantics are unchanged from the standalone version:
+exit 0 = clean, per-problem ``METRIC-NAME:`` lines on stderr otherwise.
+Dynamic call sites still annotate with ``# metric-names: a b c``; the
+machinery skip list lives on the rule
+(``analysis/passes/shape.py::MetricNamesRule``).
 
 Usage: python dev/check_metric_names.py   (exit 0 = clean)
 """
@@ -21,100 +15,37 @@ Usage: python dev/check_metric_names.py   (exit 0 = clean)
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import List, Tuple
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-ROOT = os.path.abspath(os.path.join(HERE, ".."))
-PKG = os.path.join(ROOT, "ballista_tpu")
+REPO = os.path.normpath(os.path.join(HERE, ".."))
+sys.path.insert(0, HERE)
 
-sys.path.insert(0, ROOT)
-
-from ballista_tpu.observability.registry import (  # noqa: E402
-    OPERATOR_METRICS,
-    PROCESS_METRICS,
-)
-
-_CALL = re.compile(r"\b(?:add_counter|add_time|set_gauge)\s*\(")
-# a literal first argument ends at , or ) — "elapsed_" + name is DYNAMIC
-_LITERAL_ARG = re.compile(r"^\s*(['\"])([^'\"]+)\1\s*[,)]")
-_ANNOTATION = re.compile(r"#\s*metric-names:\s*([\w\s,-]+)")
-
-# files whose add_*/set_gauge are the RECORDING MACHINERY itself (they
-# re-emit caller-supplied names, checked at the caller)
-SKIP_FILES = {
-    "ballista_tpu/observability/metrics.py",
-}
-# generated code (the pb2 module's symbol strings trip the prometheus
-# family pattern)
-SKIP_DIRS = ("ballista_tpu/proto/",)
-
-
-def scan() -> List[Tuple[str, int, str, str]]:
-    problems: List[Tuple[str, int, str, str]] = []
-    for root, _dirs, files in os.walk(PKG):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            rel = os.path.relpath(path, ROOT).replace(os.sep, "/")
-            if rel in SKIP_FILES or rel.startswith(SKIP_DIRS):
-                continue
-            for i, line in enumerate(open(path, encoding="utf-8"), 1):
-                dynamic = False
-                for m in _CALL.finditer(line):
-                    rest = line[m.end():]
-                    lit = _LITERAL_ARG.match(rest)
-                    if lit is None:
-                        dynamic = True
-                        continue
-                    name = lit.group(2)
-                    if name not in OPERATOR_METRICS:
-                        problems.append(
-                            (rel, i, name,
-                             "literal metric name not in "
-                             "OPERATOR_METRICS registry"))
-                # dynamic names need an annotation listing the space
-                if dynamic:
-                    ann = _ANNOTATION.search(line)
-                    if ann is None:
-                        problems.append(
-                            (rel, i, line.strip()[:80],
-                             "dynamic metric name without a "
-                             "'# metric-names: ...' annotation"))
-                    else:
-                        for name in re.split(r"[\s,]+",
-                                             ann.group(1).strip()):
-                            if name and name not in OPERATOR_METRICS:
-                                problems.append(
-                                    (rel, i, name,
-                                     "annotated metric name not in "
-                                     "OPERATOR_METRICS registry"))
-                # prometheus family literals in sample tuples
-                for fam in re.findall(r"(['\"])(ballista_\w+)\1\s*,",
-                                      line):
-                    if fam[1] not in PROCESS_METRICS:
-                        problems.append(
-                            (rel, i, fam[1],
-                             "prometheus family not in PROCESS_METRICS "
-                             "registry"))
-    return problems
+import analyze  # noqa: E402 - sibling loader for the analysis engine
 
 
 def main() -> int:
-    problems = scan()
+    analysis = analyze.load_analysis(REPO)
+    pkg = analysis.Package.load(REPO)
+    rule = analysis.RULE_FACTORIES["metric-names"]()
+    result = analysis.analyze(pkg, [rule])
+    problems = result.parse_errors + result.findings
     if problems:
-        for rel, i, name, why in problems:
-            print(f"METRIC-NAME: {rel}:{i}: {name!r}: {why}",
+        for f in problems:
+            print(f"METRIC-NAME: {f.file}:{f.line}: {f.message}",
                   file=sys.stderr)
         print(
-            f"{len(problems)} unregistered metric name(s) — register "
-            "them in ballista_tpu/observability/registry.py (they feed "
-            "/metrics export and docs/observability.md)",
+            f"{len(problems)} unregistered metric name(s) — "
+            "register them in ballista_tpu/observability/registry.py "
+            "(they feed /metrics export and docs/observability.md)",
             file=sys.stderr,
         )
         return 1
+    from ballista_tpu.observability.registry import (
+        OPERATOR_METRICS,
+        PROCESS_METRICS,
+    )
+
     print(f"all metric names registered "
           f"({len(OPERATOR_METRICS)} operator, "
           f"{len(PROCESS_METRICS)} process families)")

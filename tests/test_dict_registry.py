@@ -403,6 +403,8 @@ def test_dict_sites_lint_clean():
 
 def test_dict_sites_lint_detects(tmp_path):
     # the lint actually fires on a host unify site outside the registry
+    # (staged tree ships the shim + the analysis engine it runs on; the
+    # engine loads standalone, so no ballista_tpu/__init__ is needed)
     import shutil
 
     stage = tmp_path / "repo"
@@ -413,8 +415,11 @@ def test_dict_sites_lint_detects(tmp_path):
         "import numpy as np\n"
         "def unify(dicts):\n"
         "    return np.unique(np.concatenate(dicts))\n")
-    shutil.copy(os.path.join(REPO, "dev", "check_dict_sites.py"),
-                stage / "dev" / "check_dict_sites.py")
+    for f in ("check_dict_sites.py", "analyze.py"):
+        shutil.copy(os.path.join(REPO, "dev", f), stage / "dev" / f)
+    shutil.copytree(os.path.join(REPO, "ballista_tpu", "analysis"),
+                    pkg / "analysis",
+                    ignore=shutil.ignore_patterns("__pycache__"))
     r = subprocess.run(
         [sys.executable, str(stage / "dev" / "check_dict_sites.py")],
         capture_output=True, text=True)
