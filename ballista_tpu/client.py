@@ -285,15 +285,16 @@ class BallistaContext:
         ``system.queries`` with the given reason."""
         with self._lifecycle_lock:
             tokens = list(self._active_tokens)
-            job_ids = [jid for sink in self._active_job_sinks
-                       for jid in list(sink)]
+            sinks = list(self._active_job_sinks)
+            job_ids = [jid for sink in sinks
+                       for jid in list(sink) if isinstance(jid, str)]
         n = 0
         for t in tokens:
             n += bool(t.cancel(reason))
-        if self.mode == "remote" and job_ids:
+        if self.mode == "remote" and sinks:
             import logging
 
-            from .distributed.client import cancel_job
+            from .distributed.client import CancelRequested, cancel_job
 
             for jid in job_ids:
                 try:
@@ -302,6 +303,11 @@ class BallistaContext:
                 except Exception:  # noqa: BLE001 - best-effort
                     logging.getLogger("ballista.lifecycle").warning(
                         "CancelJob(%s) failed", jid, exc_info=True)
+            # a collect sleeping between admission-retry attempts has
+            # no live job to CancelJob: the sentinel stops its loop
+            # before it resubmits the query the user just cancelled
+            for sink in sinks:
+                sink.append(CancelRequested(reason))
         return n
 
     def _collect(self, plan: LogicalPlan, on_progress=None):
@@ -340,7 +346,8 @@ class BallistaContext:
                 # channel ctx.cancel() uses) over the last finished one
                 with self._lifecycle_lock:
                     inflight = [j for sink in self._active_job_sinks
-                                for j in list(sink)]
+                                for j in list(sink)
+                                if isinstance(j, str)]
                 jid = (inflight[-1] if inflight else None) \
                     or self._last_job_id
             if not jid:

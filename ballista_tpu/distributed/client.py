@@ -12,7 +12,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ..errors import ClusterError, PlanError, QueryCancelled
+from ..errors import (AdmissionRejected, ClusterError, PlanError,
+                      QueryCancelled)
 from ..proto import ballista_pb2 as pb
 from .. import serde
 from .dataplane import fetch_partition_bytes
@@ -35,6 +36,20 @@ def _deadline_secs(settings: Optional[Dict[str, str]]) -> float:
                            "(expected seconds as a number)") from None
 
 
+def _job_id_or_shed(result: pb.ExecuteQueryResult) -> str:
+    """Admission plane: a shed submission comes back with a structured
+    retryable error instead of a live job id — raise it as
+    :class:`AdmissionRejected` (``remote_collect`` honors the
+    retry-after within the client's job timeout)."""
+    if result.error:
+        parsed = AdmissionRejected.parse(result.error)
+        if parsed is not None:
+            raise AdmissionRejected(parsed[0],
+                                    result.retry_after_secs or parsed[1])
+        raise ClusterError(result.error)
+    return result.job_id
+
+
 def submit_plan(host: str, port: int, logical_plan,
                 settings: Optional[Dict[str, str]] = None) -> str:
     client = SchedulerClient(host, port)
@@ -44,7 +59,7 @@ def submit_plan(host: str, port: int, logical_plan,
         for k, v in (settings or {}).items():
             params.settings[k] = v
         params.deadline_secs = _deadline_secs(settings)
-        return client.ExecuteQuery(params).job_id
+        return _job_id_or_shed(client.ExecuteQuery(params))
     finally:
         client.close()
 
@@ -133,7 +148,7 @@ def submit_sql(host: str, port: int, sql: str, catalog,
                 serde.source_to_proto(ct.source, ct.primary_key)
             )
         params.deadline_secs = _deadline_secs(settings)
-        return client.ExecuteQuery(params).job_id
+        return _job_id_or_shed(client.ExecuteQuery(params))
     finally:
         client.close()
 
@@ -183,9 +198,18 @@ def wait_for_job(host: str, port: int, job_id: str,
                 # progress UI must not show "running" as the job dies
                 _emit_progress(result, job_id, on_progress, last,
                                status="failed")
+                err = result.status.failed.error
+                parsed = AdmissionRejected.parse(err)
+                if parsed is not None or \
+                        result.status.failed.retry_after_secs > 0:
+                    # a queue-timeout shed: retryable by contract
+                    reason, after = parsed or ("queue-timeout", 0.0)
+                    raise AdmissionRejected(
+                        reason,
+                        result.status.failed.retry_after_secs or after,
+                        job_id=job_id)
                 raise ClusterError(
-                    f"job {job_id} failed: {result.status.failed.error}",
-                    job_id=job_id,
+                    f"job {job_id} failed: {err}", job_id=job_id,
                 )
             if which == "cancelled":
                 # terminal Cancelled (client CancelJob, server deadline,
@@ -240,6 +264,81 @@ def _job_timeout(settings: Optional[Dict[str, str]],
                            "(expected seconds as a number)") from None
 
 
+class CancelRequested:
+    """Sentinel ``BallistaContext.cancel()`` drops into an in-flight
+    collect's job-id sink: a cancel that lands BETWEEN admission-retry
+    attempts (the shed job is already terminal, so CancelJob had
+    nothing to hit) must still stop the retry loop — resubmitting a
+    query the user just cancelled breaks the cancel contract."""
+
+    __slots__ = ("reason",)
+
+    def __init__(self, reason: str = "client"):
+        self.reason = reason
+
+
+def _cancel_requested(job_id_out):
+    return next((x for x in (job_id_out or [])
+                 if isinstance(x, CancelRequested)), None)
+
+
+def _admission_retry_enabled() -> bool:
+    """``BALLISTA_ADMISSION_RETRY`` (default on): ``remote_collect``
+    honors a shed's retry-after — sleep and resubmit within the
+    client's job timeout. ``0``/``off`` surfaces the AdmissionRejected
+    immediately (callers running their own backoff)."""
+    return os.environ.get("BALLISTA_ADMISSION_RETRY", "on").lower() \
+        not in ("0", "off", "false", "no")
+
+
+def _collect_with_admission_retry(deadline_secs: float, submit_fn,
+                                  wait_fn, job_id_out=None,
+                                  cancel_fn=None):
+    """One submit+wait attempt loop honoring admission retry-after:
+    a shed (at the gate, or a queue-timeout mid-wait) sleeps the
+    server's retry_after_secs and resubmits, as long as the NEXT
+    attempt still fits inside the caller's job-timeout budget. The
+    timeout stays one end-to-end bound across attempts — admission
+    pressure never extends how long a caller can block.
+
+    ``job_id_out`` is populated at SUBMIT time (and replaced on a
+    resubmission): a concurrent ``ctx.cancel()`` must reach the job
+    WHILE this thread waits on it, not after."""
+    deadline_ts = time.time() + deadline_secs
+    while True:
+        mark = _cancel_requested(job_id_out)
+        if mark is not None:
+            raise QueryCancelled(mark.reason)
+        try:
+            job_id = submit_fn()
+            if job_id_out is not None:
+                # PRESERVE any sentinel a racing ctx.cancel() appended
+                # while the submit RPC was in flight — a plain replace
+                # would destroy it and the cancel would be lost
+                job_id_out[:] = [x for x in job_id_out
+                                 if isinstance(x, CancelRequested)] \
+                    + [job_id]
+            mark = _cancel_requested(job_id_out)
+            if mark is not None:
+                # the cancel raced the submit: the job exists but the
+                # canceller's CancelJob pass never saw its id — issue
+                # it here before raising
+                if cancel_fn is not None:
+                    try:
+                        cancel_fn(job_id, mark.reason)
+                    except Exception:  # noqa: BLE001 - best-effort
+                        pass
+                raise QueryCancelled(mark.reason, job_id=job_id)
+            return job_id, wait_fn(job_id,
+                                   max(deadline_ts - time.time(), 0.01))
+        except AdmissionRejected as e:
+            wait = min(max(e.retry_after_secs, 0.05), 30.0)
+            if not _admission_retry_enabled() or \
+                    time.time() + wait >= deadline_ts:
+                raise
+            time.sleep(wait)
+
+
 def remote_collect(host: str, port: int, logical_plan,
                    settings: Optional[Dict[str, str]] = None,
                    timeout: Optional[float] = None,
@@ -252,16 +351,20 @@ def remote_collect(host: str, port: int, logical_plan,
     receives the scheduler-assigned job id (the handle the distributed
     profiler's GetJobProfile / /debug/profile/<job_id> take);
     ``on_progress`` receives live progress snapshots off the status
-    poll (the ONE shape — see observability/progress.py)."""
+    poll (the ONE shape — see observability/progress.py). Admission
+    sheds are retried per their retry-after within the job timeout."""
     from ..execution import resolve_scalar_subqueries
 
     deadline = _job_timeout(settings, timeout)  # fail fast pre-submit
     logical_plan = resolve_scalar_subqueries(logical_plan)
-    job_id = submit_plan(host, port, logical_plan, settings)
-    if job_id_out is not None:
-        job_id_out.append(job_id)
-    result = wait_for_job(host, port, job_id, deadline,
-                          on_progress=on_progress)
+    _job_id, result = _collect_with_admission_retry(
+        deadline,
+        lambda: submit_plan(host, port, logical_plan, settings),
+        lambda jid, left: wait_for_job(host, port, jid, left,
+                                       on_progress=on_progress),
+        job_id_out=job_id_out,
+        cancel_fn=lambda jid, reason: cancel_job(host, port, jid,
+                                                 reason))
     _deliver_metrics(result, metrics_out)
     return _fetch_result_frames(result)
 
@@ -274,11 +377,14 @@ def remote_sql_collect(host: str, port: int, sql: str, catalog,
                        on_progress=None):
     """Raw-SQL round trip: submit SQL + catalog, poll, fetch."""
     deadline = _job_timeout(settings, timeout)  # fail fast pre-submit
-    job_id = submit_sql(host, port, sql, catalog, settings)
-    if job_id_out is not None:
-        job_id_out.append(job_id)
-    result = wait_for_job(host, port, job_id, deadline,
-                          on_progress=on_progress)
+    _job_id, result = _collect_with_admission_retry(
+        deadline,
+        lambda: submit_sql(host, port, sql, catalog, settings),
+        lambda jid, left: wait_for_job(host, port, jid, left,
+                                       on_progress=on_progress),
+        job_id_out=job_id_out,
+        cancel_fn=lambda jid, reason: cancel_job(host, port, jid,
+                                                 reason))
     _deliver_metrics(result, metrics_out)
     return _fetch_result_frames(result)
 

@@ -41,6 +41,13 @@ DEFAULTS = {
 
 
 def main(argv=None) -> int:
+    # sigwait below only receives a signal that is BLOCKED; without
+    # this mask SIGTERM takes the default disposition (immediate kill)
+    # and the graceful-drain path (PR 9) never runs on the real binary.
+    # Masked first thing so every thread the executor spawns inherits
+    # the block and only the main thread's sigwait consumes the signal.
+    signal.pthread_sigmask(signal.SIG_BLOCK,
+                           {signal.SIGINT, signal.SIGTERM})
     ap = argparse.ArgumentParser(description="ballista-tpu executor")
     ap.add_argument("--config-file", default=None)
     ap.add_argument("--local", action="store_true",

@@ -272,6 +272,14 @@ class SchedulerService:
         from ..observability.progress import JobProgressTracker
 
         self.progress = JobProgressTracker(state=state)
+        # admission plane (distributed/admission.py): every
+        # ExecuteQuery passes the gate; queued submissions hold their
+        # planning args here until the pump admits (or sheds) them
+        from .admission import AdmissionController
+
+        self.admission = AdmissionController(
+            state=state, launch_fn=self._launch_job,
+            shed_fn=self._shed_queued_job)
         # merge/render/write of terminal-job artifacts runs here, OFF
         # the RPC handler threads (thread created lazily on first use:
         # unprofiled schedulers never spawn it)
@@ -296,7 +304,11 @@ class SchedulerService:
             executors_fn=self._executor_rows,
             tasks_fn=self.progress.task_rows,
             stages_fn=self.progress.stage_rows,
+            admission_fn=self.admission.decision_rows,
         )
+        # system.queries / /debug/queries: queued rows carry their live
+        # admission-queue position
+        state.queue_info_fn = self.admission.queue_info
         self.tasks_dispatched = 0
         if metrics_port is None:
             metrics_port = metrics_port_from_env(-1)
@@ -319,6 +331,15 @@ class SchedulerService:
             ("ballista_tasks_dispatched_total", {}, self.tasks_dispatched),
             ("ballista_ready_queue_depth", {}, st.ready_queue_depth()),
             ("ballista_slow_queries_total", {}, st.query_log.slow_total),
+            # admission plane: queue depth + the decision counters
+            ("ballista_admission_queue_depth", {},
+             self.admission.queue_depth()),
+            ("ballista_admission_admitted_total", {},
+             self.admission.admitted_total),
+            ("ballista_admission_queued_total", {},
+             self.admission.queued_total),
+            ("ballista_admission_sheds_total", {},
+             self.admission.sheds_total),
         ]
         # live progress gauges: per-job completion fraction + the
         # cluster-wide running-task count (gated through the registry
@@ -389,10 +410,24 @@ class SchedulerService:
 
     def _debug_jobs(self, job_id: "str | None"):
         """``/debug/jobs`` (job_id None: every live job) and
-        ``/debug/jobs/<job_id>`` (live or recently terminal)."""
+        ``/debug/jobs/<job_id>`` (live or recently terminal). Queued
+        jobs carry their admission-queue position/reason."""
+        def enrich(snap):
+            if snap and snap.get("status") == "queued":
+                info = self.admission.queue_info(snap["job_id"])
+                if info:
+                    snap = {**snap, **info}
+            return snap
+
         if job_id:
-            return self.progress.snapshot(job_id)
-        return self.progress.live_snapshots()
+            return enrich(self.progress.snapshot(job_id))
+        return [enrich(s) for s in self.progress.live_snapshots()]
+
+    def begin_drain(self):
+        """Degrade to rejecting NEW work while admitted work finishes
+        (the admission ladder's terminal rung; scheduler_main flips it
+        on SIGTERM before waiting out live jobs)."""
+        self.admission.begin_drain()
 
     def close_health(self):
         if self.health is not None:
@@ -419,6 +454,16 @@ class SchedulerService:
         from ..observability.registry import observe_histogram
 
         self.profiles.finalize(job_id, summary)
+        # admission plane: release the session's concurrency slot (and
+        # any queue entry — a cancelled/reaped queued job leaves the
+        # queue here), then pump so a freed slot admits waiting work
+        # immediately instead of on the next heartbeat
+        try:
+            self.admission.on_terminal(job_id)
+            self.admission.pump(force=True)
+        except Exception:  # noqa: BLE001 - must not take the job down
+            log.exception("admission terminal hook failed for job %s",
+                          job_id)
         # live progress: freeze the final snapshot (fraction exactly
         # 1.0 for completed jobs) and drop the job's sample store
         try:
@@ -574,32 +619,86 @@ class SchedulerService:
     def ExecuteQuery(self, request: pb.ExecuteQueryParams, context=None):
         job_id = _job_id()
         settings = dict(request.settings)
+        # admission gate FIRST (needs only the settings): a shed must
+        # not pay plan deserialization or persist any job state — the
+        # submission never existed
+        decision = self.admission.gate(job_id, settings,
+                                       request.deadline_secs)
+        if decision.action == "shed":
+            err = decision.error()
+            return pb.ExecuteQueryResult(
+                job_id=job_id, error=str(err),
+                retry_after_secs=err.retry_after_secs)
         if request.deadline_secs > 0:
             # server-side deadline: armed BEFORE planning (a stuck plan
-            # counts) and enforced by the PollWork reap pass, so the job
-            # dies on time even when the submitting client is gone
+            # counts — and an admission-QUEUED job's wait counts too)
+            # and enforced by the PollWork reap pass, so the job dies
+            # on time even when the submitting client is gone
             self.state.save_job_deadline(
                 job_id, time.time() + request.deadline_secs)
-        if request.WhichOneof("query") == "logical_plan":
-            plan = serde.plan_from_proto(request.logical_plan)
-            args = (job_id, plan, settings, None, None)
+        try:
+            if request.WhichOneof("query") == "logical_plan":
+                plan = serde.plan_from_proto(request.logical_plan)
+                args = (job_id, plan, settings, None, None)
+            else:
+                # raw SQL: planned server-side in the background thread
+                # (like plan failures, SQL errors land in
+                # JobStatus('failed') rather than an opaque transport
+                # error; reference accepts sql-or-plan, lib.rs:236-247)
+                args = (job_id, None, settings, request.sql,
+                        list(request.catalog))
+            self.state.save_job_status(job_id, JobStatus("queued"))
+            # live progress: track from submission so /debug/jobs
+            # answers during planning too (fraction 0, no stages yet)
+            self.progress.register_job(job_id)
+        except BaseException:
+            # the submission dies before it exists (bad plan proto):
+            # release the gate's reservation or the session leaks a
+            # concurrency slot forever
+            self.admission.on_terminal(job_id)
+            raise
+        if decision.action == "queue":
+            # planning deferred: the pump launches (or sheds) it later;
+            # status stays "queued" with a visible queue position
+            self.admission.enqueue(decision, args)
+            if self.state.is_job_cancelled(job_id):
+                # a cancel raced the enqueue (its terminal hook ran
+                # before the entry existed): drop the stale entry now —
+                # the pump's pre-launch terminal re-check is the
+                # backstop for the window that remains
+                self.admission.on_terminal(job_id)
         else:
-            # raw SQL: planned server-side in the background thread (like
-            # plan failures, SQL errors land in JobStatus('failed') rather
-            # than an opaque transport error; reference accepts
-            # sql-or-plan, lib.rs:236-247)
-            args = (job_id, None, settings, request.sql,
-                    list(request.catalog))
-        self.state.save_job_status(job_id, JobStatus("queued"))
-        # live progress: track from submission so /debug/jobs answers
-        # during planning too (fraction 0, no stages yet)
-        self.progress.register_job(job_id)
+            try:
+                self._launch_job(args)
+            except BaseException as e:
+                # thread spawn failed (fd/thread pressure — exactly the
+                # overload regime): the job must not sit status=queued
+                # forever holding its admitted slot. The terminal save
+                # fires the hook, which releases the slot.
+                self.state.save_job_status(job_id, JobStatus(
+                    "failed", error=f"planning launch failed: {e}"))
+                raise
+        return pb.ExecuteQueryResult(job_id=job_id)
+
+    def _launch_job(self, args):
+        """Start the background planning thread for an ADMITTED job
+        (straight from the gate, or later from the admission pump)."""
         t = threading.Thread(
             target=self._plan_job, args=args, daemon=True,
-            name=f"plan-{job_id}",
+            name=f"plan-{args[0]}",
         )
         t.start()
-        return pb.ExecuteQueryResult(job_id=job_id)
+
+    def _shed_queued_job(self, decision):
+        """Admission queue timeout: the job was accepted (status queued,
+        visible, cancellable) but never admitted — move it to a
+        terminal FAILED state whose error is the structured retryable
+        shed, so the waiting client's poll raises AdmissionRejected."""
+        if self.state.is_job_cancelled(decision.job_id):
+            return  # a racing cancel already made it terminal
+        self.state.save_job_status(
+            decision.job_id,
+            JobStatus("failed", error=str(decision.error())))
 
     def _plan_sql(self, sql: str, catalog_entries):
         from ..sql.parser import CreateExternalTable, parse_sql
@@ -752,6 +851,9 @@ class SchedulerService:
         # lifecycle reap: expired server-side deadlines + the slow-query
         # killer (already-terminal, so not re-synchronized below)
         self.state.reap_expired_jobs()
+        # admission queue: heartbeats drive timeout sheds + freed-slot
+        # admissions (throttled internally, like the reap pass)
+        self.admission.pump()
         # late reports from tasks of a cancelled job: the terminal state
         # stands — no recovery, no re-queue, and a completion must not
         # resurrect dependents. Memoized per request: is_job_cancelled
@@ -818,7 +920,10 @@ class SchedulerService:
             task = self.state.next_task(meta.num_devices)
             if task is None and self.speculation_age_secs > 0:
                 task = self.state.speculative_task(
-                    meta.num_devices, self.speculation_age_secs, meta.id
+                    meta.num_devices, self.speculation_age_secs, meta.id,
+                    # rate-based trigger off the live progress samples
+                    # (age stays the fallback when no samples exist)
+                    lag_fn=self.progress.speculation_lag_fn(),
                 )
                 if task is not None:
                     log.warning("speculating straggler task %s on executor "
@@ -926,14 +1031,24 @@ class SchedulerService:
     def GetJobStatus(self, request: pb.GetJobStatusParams, context=None):
         # lifecycle reap rides status polls too: with every executor
         # down there are no PollWork calls, but a waiting client still
-        # drives deadline/slow-query-kill enforcement for its job
+        # drives deadline/slow-query-kill enforcement for its job —
+        # and the admission pump, so a queue drains (or times out)
+        # even with zero executors registered
         self.state.reap_expired_jobs()
+        self.admission.pump()
         st = self.state.get_job_status(request.job_id)
         result = pb.GetJobStatusResult()
         if st is None:
             result.status.failed.error = f"unknown job {request.job_id}"
         elif st.state == "queued":
             result.status.queued.SetInParent()
+            info = self.admission.queue_info(request.job_id)
+            if info:
+                result.status.queued.queue_position = \
+                    info["queue_position"]
+                result.status.queued.reason = info["reason"] or ""
+                result.status.queued.queued_seconds = \
+                    info["queued_seconds"]
         elif st.state == "running":
             result.status.running.SetInParent()
         elif st.state == "cancelled":
@@ -941,6 +1056,12 @@ class SchedulerService:
                 getattr(st, "cancel_reason", None) or "unknown"
         elif st.state == "failed":
             result.status.failed.error = st.error or "unknown error"
+            from ..errors import AdmissionRejected
+
+            parsed = AdmissionRejected.parse(st.error or "")
+            if parsed is not None:
+                # a queue-timeout shed: structured AND machine-readable
+                result.status.failed.retry_after_secs = parsed[1]
         else:
             for loc in st.partition_locations or []:
                 result.status.completed.partition_location.append(

@@ -29,6 +29,13 @@ DEFAULTS = {
 
 
 def main(argv=None) -> int:
+    # sigwait below only receives a signal that is BLOCKED; without
+    # this mask SIGTERM takes the default disposition (immediate kill)
+    # and the graceful-drain path never runs. Masked first thing so
+    # every thread the server spawns inherits the block and the signal
+    # can only be consumed by the main thread's sigwait.
+    signal.pthread_sigmask(signal.SIG_BLOCK,
+                           {signal.SIGINT, signal.SIGTERM})
     ap = argparse.ArgumentParser(description="ballista-tpu scheduler")
     ap.add_argument("--config-file", default=None)
     for key in DEFAULTS:
@@ -108,7 +115,28 @@ def main(argv=None) -> int:
         print(f"ballista-tpu Arrow Flight SQL endpoint on "
               f"{cfg['bind_host']}:{fport}", flush=True)
     stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
-    print(f"signal {stop}; shutting down", flush=True)
+    if stop == signal.SIGTERM:
+        # graceful degradation (admission ladder's last rung): shed NEW
+        # submissions while admitted work finishes, bounded by the same
+        # drain knob executors use
+        print(f"signal {stop}; draining (new submissions are shed)",
+              flush=True)
+        _svc.begin_drain()
+        import time as _time
+
+        from .executor import drain_timeout_secs
+
+        deadline = _time.time() + drain_timeout_secs()
+        while _time.time() < deadline:
+            try:
+                if not _svc.progress.live_snapshots() and \
+                        _svc.admission.queue_depth() == 0:
+                    break
+            except Exception:  # noqa: BLE001 - shutdown path
+                break
+            _time.sleep(0.25)
+    else:
+        print(f"signal {stop}; shutting down", flush=True)
     if flight_server is not None:
         flight_server.shutdown()
     server.stop(grace=2)

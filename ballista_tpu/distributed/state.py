@@ -18,6 +18,7 @@ a global lock — stage-dependency checks run only when a stage completes.
 from __future__ import annotations
 
 import logging
+import os
 import pickle
 import sqlite3
 import threading
@@ -237,6 +238,10 @@ class SchedulerState:
         # artifact and enrich the summary before it enters the query log
         self._job_digests: Dict[str, str] = {}
         self.profile_hook = None
+        # admission plane: queue_info_fn(job_id) -> {"queue_position",
+        # "reason", "queued_seconds"} | None, installed by the scheduler
+        # service so queued system.queries rows show their position
+        self.queue_info_fn = None
         self._rehydrate()
 
     def _rehydrate(self):
@@ -339,6 +344,18 @@ class SchedulerState:
         elif status.state in ("completed", "failed", "cancelled"):
             with self._lock:
                 self._job_deadlines.pop(job_id, None)
+                # per-job speculation state is dead with the job: these
+                # sets (and the recovery counter below) otherwise grow
+                # for the scheduler's lifetime (leak test pins this)
+                if self._speculated:
+                    self._speculated = {
+                        p for p in self._speculated
+                        if p.job_id != job_id}
+                if self._spec_failed_once:
+                    self._spec_failed_once = {
+                        p for p in self._spec_failed_once
+                        if p.job_id != job_id}
+            self.kv.delete(self._k("recoveries", job_id))
             t0 = self._job_started.pop(job_id, None)
             if t0 is not None:
                 if status.state == "completed":
@@ -414,12 +431,20 @@ class SchedulerState:
             state = js.state if js is not None else "queued"
             if state not in ("queued", "running"):
                 continue
-            out.append(systables.build_query_record(
+            rec = systables.build_query_record(
                 job_id, state, now - t0,
                 plan_digest=self._job_digests.get(job_id),
                 num_stages=len(self.stage_ids(job_id)) or None,
                 started_at=t0, origin="cluster",
-            ))
+            )
+            if state == "queued" and self.queue_info_fn is not None:
+                try:
+                    info = self.queue_info_fn(job_id)
+                except Exception:  # noqa: BLE001 - advisory
+                    info = None
+                if info:
+                    rec["queue_position"] = info["queue_position"]
+            out.append(rec)
         return out
 
     def save_job_digest(self, job_id: str, digest: str):
@@ -890,7 +915,21 @@ class SchedulerState:
     # failures and re-queue running tasks of dead executors, with a
     # per-job retry cap.
 
-    MAX_RECOVERIES_PER_JOB = 3
+    DEFAULT_MAX_RECOVERIES = 3
+
+    @property
+    def MAX_RECOVERIES_PER_JOB(self) -> int:
+        """``BALLISTA_MAX_TASK_RECOVERIES`` (default 3): recovery
+        EVENTS allowed per job across all recovery paths (transient
+        retry, fetch recovery, lease reap) before the job fails with
+        the underlying error. Read per use so the chaos sweep and
+        operators can tune the budget without restarting."""
+        try:
+            return max(int(os.environ.get(
+                "BALLISTA_MAX_TASK_RECOVERIES", "")
+                or self.DEFAULT_MAX_RECOVERIES), 0)
+        except ValueError:
+            return self.DEFAULT_MAX_RECOVERIES
 
     def _recovery_count(self, job_id: str) -> int:
         v = self.kv.get(self._k("recoveries", job_id))
@@ -988,8 +1027,8 @@ class SchedulerState:
     def speculative_task(self, num_devices: int = 0,
                          age_secs: float = 60.0,
                          executor_id: str = "",
-                         min_interval_secs: Optional[float] = None
-                         ) -> Optional[PartitionId]:
+                         min_interval_secs: Optional[float] = None,
+                         lag_fn=None) -> Optional[PartitionId]:
         """Straggler mitigation the reference lacks entirely: when an
         executor is idle and nothing is ready, hand out a DUPLICATE of a
         long-running task (first completion wins — task_completed drops
@@ -999,7 +1038,15 @@ class SchedulerState:
         would race the original on the same work_dir path), and fruitless
         full-task scans are throttled like reap_lost_tasks (a successful
         scan doesn't delay the next one — only the idle-poll storm with
-        nothing to speculate is capped)."""
+        nothing to speculate is capped).
+
+        ``lag_fn(task_status) -> bool | None`` is the RATE-based
+        trigger (the scheduler wires the progress tracker's
+        ``is_lagging`` here): True = the task's observed rate trails
+        its stage median by ``BALLISTA_SPECULATION_LAG_FACTOR`` —
+        duplicate it regardless of age; False = the task is measurably
+        healthy — do NOT duplicate it even past the age threshold;
+        None = no samples — fall back to the wall-clock age trigger."""
         if min_interval_secs is None:
             min_interval_secs = self.SPECULATION_SCAN_INTERVAL_SECS
         now = time.time()
@@ -1018,9 +1065,20 @@ class SchedulerState:
                 for t in self.get_task_statuses(job_id):
                     key = t.partition
                     if (t.state == "running" and t.started_at
-                            and now - t.started_at > age_secs
                             and key not in self._speculated
                             and t.executor_id != executor_id):
+                        lagging = None
+                        if lag_fn is not None:
+                            try:
+                                lagging = lag_fn(t)
+                            except Exception:  # noqa: BLE001 - advisory
+                                lagging = None
+                        if lagging is None:
+                            # no rate samples: the old age trigger
+                            if now - t.started_at <= age_secs:
+                                continue
+                        elif not lagging:
+                            continue
                         need = self._stage_mesh.get(
                             (job_id, t.partition.stage_id), 0)
                         if need and num_devices and num_devices < need:

@@ -50,6 +50,44 @@ class ClusterError(BallistaError):
         self.job_id = job_id
 
 
+class AdmissionRejected(ClusterError):
+    """A submission was SHED by the scheduler's admission plane (quota
+    exhausted, queue full, queue-time timeout, draining cluster).
+    Retryable by contract: ``retry_after_secs`` tells the client when a
+    resubmission has a chance (``remote_collect`` honors it
+    automatically within the job timeout). Like
+    :class:`ShuffleFetchError`, the message format is a wire contract —
+    queue-timeout sheds travel as a terminal failed JobStatus whose
+    error string the client re-parses into this class."""
+
+    PREFIX = "ADMISSION_SHED"
+
+    def __init__(self, reason: str, retry_after_secs: float = 1.0,
+                 detail: str = "", job_id: "str | None" = None):
+        self.reason = reason
+        self.retry_after_secs = max(float(retry_after_secs), 0.0)
+        msg = (f"{self.PREFIX} reason={reason} "
+               f"retry_after={self.retry_after_secs:.3f}")
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg, job_id=job_id)
+
+    @classmethod
+    def parse(cls, message: str):
+        """Returns ``(reason, retry_after_secs)`` or None. The tag is
+        located anywhere in the message (reporters may prefix it)."""
+        idx = (message or "").find(cls.PREFIX)
+        if idx < 0:
+            return None
+        body = message[idx + len(cls.PREFIX):].split(":", 1)[0]
+        try:
+            fields = dict(kv.split("=", 1) for kv in body.split())
+            return (fields.get("reason", "unknown"),
+                    float(fields.get("retry_after", 1.0)))
+        except (KeyError, ValueError):
+            return None
+
+
 class QueryCancelled(BallistaError):
     """A query was cooperatively cancelled (client CancelJob, server
     deadline, slow-query kill, or executor drain). Terminal but NOT a

@@ -96,6 +96,18 @@ def progress_interval_secs() -> Optional[float]:
     return max(f, 0.05)
 
 
+def speculation_lag_factor() -> float:
+    """``BALLISTA_SPECULATION_LAG_FACTOR`` (default 3.0): duplicate a
+    running task when its observed row rate times this factor still
+    trails its stage's median sampled rate. Values <= 1 disable the
+    rate trigger (age fallback only)."""
+    try:
+        return float(os.environ.get(
+            "BALLISTA_SPECULATION_LAG_FACTOR", "3.0") or 3.0)
+    except ValueError:
+        return 3.0
+
+
 def executor_stale_secs() -> float:
     """``BALLISTA_EXECUTOR_STALE_SECS``: heartbeat age past which
     ``system.executors`` marks a row ``stale=true``."""
@@ -299,6 +311,90 @@ class JobProgressTracker:
                 # fresh data: the next snapshot must see it (the cache
                 # only dedupes polls BETWEEN heartbeats)
                 job.pop("cache", None)
+
+    # -- rate-based speculation (ROADMAP 5a: the scheduler CONSUMES the
+    # progress model) ---------------------------------------------------------
+
+    # a sample younger than this carries too little signal for a rate
+    MIN_RATE_ELAPSED_SECS = 1.0
+
+    def _stage_rates(self, job_id: str, stage_id: int
+                     ) -> List[Tuple[int, float]]:
+        """(partition_id, rows/sec) for every usably-sampled task of
+        the stage — one locked pass over the sample map."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return []
+            samples = [(k[1], s) for k, s in job["samples"].items()
+                       if k[0] == int(stage_id)]
+        out: List[Tuple[int, float]] = []
+        for pid, s in samples:
+            elapsed = float(s.get("elapsed_seconds") or 0.0)
+            if elapsed < self.MIN_RATE_ELAPSED_SECS:
+                continue
+            out.append((pid, float(s.get("rows_so_far", 0)) / elapsed))
+        return out
+
+    @staticmethod
+    def _lag_verdict(rates: List[Tuple[int, float]], partition_id: int,
+                     factor: float) -> Optional[bool]:
+        mine: Optional[float] = None
+        sibling_rates: List[float] = []
+        for pid, rate in rates:
+            if pid == int(partition_id):
+                mine = rate
+            else:
+                sibling_rates.append(rate)
+        if mine is None or not sibling_rates:
+            return None
+        sibling_rates.sort()
+        median = sibling_rates[len(sibling_rates) // 2]
+        if median <= 0:
+            return None
+        return mine * factor < median
+
+    def is_lagging(self, job_id: str, stage_id: int, partition_id: int,
+                   factor: Optional[float] = None) -> Optional[bool]:
+        """Rate verdict for one running task, from the stage's latest
+        progress samples: True = its observed row rate times
+        ``BALLISTA_SPECULATION_LAG_FACTOR`` still trails the median
+        rate of its stage SIBLINGS (duplicate it); False = measurably
+        keeping up (do not); None = no verdict — the task or its stage
+        has no usable samples, the caller falls back to the age
+        trigger. A sampled task stuck at 0 rows reads rate 0 and lags
+        any progressing stage."""
+        if factor is None:
+            factor = speculation_lag_factor()
+        if factor <= 1.0:
+            return None
+        return self._lag_verdict(self._stage_rates(job_id, stage_id),
+                                 partition_id, factor)
+
+    def speculation_lag_fn(self):
+        """The ``lag_fn`` SchedulerState.speculative_task consumes, or
+        None when the progress plane is off (pure age fallback). The
+        returned closure is built fresh per speculation SCAN and caches
+        one rate snapshot per (job, stage) — the scan calls it for
+        every running task, and rescanning the sample map (plus the env
+        read) per task would be O(tasks x samples) on the PollWork
+        handler thread."""
+        if progress_interval_secs() is None:
+            return None
+        factor = speculation_lag_factor()
+        if factor <= 1.0:
+            return None
+        rate_cache: Dict[Tuple[str, int], List[Tuple[int, float]]] = {}
+
+        def lag(t) -> Optional[bool]:
+            key = (t.partition.job_id, t.partition.stage_id)
+            rates = rate_cache.get(key)
+            if rates is None:
+                rates = rate_cache[key] = self._stage_rates(*key)
+            return self._lag_verdict(rates, t.partition.partition_id,
+                                     factor)
+
+        return lag
 
     # -- snapshots -----------------------------------------------------------
 

@@ -143,6 +143,26 @@ PROCESS_METRICS = {
                                "summed task seconds per completed stage "
                                "(label stage=...), observed at job "
                                "completion"),
+    # admission plane (scheduler; distributed/admission.py)
+    "ballista_admission_queue_depth": ("gauge", "submissions waiting in "
+                                                "the admission queue"),
+    "ballista_admission_admitted_total": ("counter", "submissions "
+                                                     "admitted (at the "
+                                                     "gate or from the "
+                                                     "queue)"),
+    "ballista_admission_queued_total": ("counter", "submissions held in "
+                                                   "the admission queue "
+                                                   "at the gate"),
+    "ballista_admission_sheds_total": ("counter", "submissions shed with "
+                                                  "a retryable error "
+                                                  "(budget, queue-full, "
+                                                  "queue-timeout, "
+                                                  "draining)"),
+    "ballista_admission_queue_wait_seconds": ("histogram",
+                                              "time submissions spent in "
+                                              "the admission queue "
+                                              "(label outcome=admitted|"
+                                              "shed)"),
 }
 
 # -- process-level histograms -------------------------------------------------

@@ -196,6 +196,20 @@ KNOBS: Dict[str, tuple] = {
     "BALLISTA_POLL_BACKOFF_MAX_SECS": ("8", "executor poll-loop backoff "
                                             "ceiling while the scheduler "
                                             "is unreachable"),
+    "BALLISTA_MAX_TASK_RECOVERIES": ("3", "recovery events allowed per "
+                                          "job (transient retry, fetch "
+                                          "recovery, lease reap) before "
+                                          "the job fails"),
+    "BALLISTA_SPECULATION_LAG_FACTOR": ("3.0", "duplicate a running task "
+                                               "when its sampled row rate "
+                                               "x this factor trails the "
+                                               "stage median (<=1 = age "
+                                               "trigger only)"),
+    "BALLISTA_ADMISSION_RETRY": ("on", "remote_collect honors admission "
+                                       "shed retry-after (sleep + "
+                                       "resubmit within the job "
+                                       "timeout; off = raise "
+                                       "immediately)"),
 }
 
 # dynamic env-name families: read via computed names, documented as
@@ -207,6 +221,10 @@ KNOB_PREFIXES: Dict[str, str] = {
                            "(distributed/config.py)",
     "BALLISTA_EXECUTOR_": "executor binary config overrides "
                           "(distributed/config.py)",
+    "BALLISTA_ADMISSION_": "admission.* setting fallbacks "
+                           "(distributed/admission.py; quotas, "
+                           "saturation bound, queue timeout — see "
+                           "docs/robustness.md)",
 }
 
 
@@ -246,6 +264,9 @@ SYSTEM_SCHEMAS: Dict[str, Schema] = {
         ("peak_host_bytes", Int64), ("peak_device_bytes", Int64),
         ("profile_artifact", Utf8), ("error", Utf8),
         ("cancel_reason", Utf8), ("origin", Utf8),
+        # admission plane: live 1-based queue position while a job is
+        # held in the scheduler's admission queue (NULL otherwise)
+        ("queue_position", Int64),
     ),
     "system.query_lanes": make_schema(
         ("job_id", Utf8), ("plan_digest", Utf8), ("lane", Utf8),
@@ -299,6 +320,15 @@ SYSTEM_SCHEMAS: Dict[str, Schema] = {
         ("device_blocked_seconds", Float64), ("bytes_shuffled", Int64),
         ("peak_host_bytes", Int64), ("peak_device_bytes", Int64),
         ("started_at", Float64), ("last_active", Float64),
+    ),
+    # admission plane (distributed/admission.py): recent gate/pump
+    # decisions — the scheduler's ring on the cluster path, empty
+    # standalone (collects never pass an admission gate)
+    "system.admission": make_schema(
+        ("job_id", Utf8), ("session_id", Utf8), ("decision", Utf8),
+        ("reason", Utf8), ("priority", Float64),
+        ("cluster_load", Int64), ("queue_wait_seconds", Float64),
+        ("retry_after_seconds", Float64), ("decided_at", Float64),
     ),
 }
 
@@ -781,6 +811,7 @@ def _queries_rows(query_log) -> List[dict]:
             "error": rec.get("error"),
             "cancel_reason": rec.get("cancel_reason"),
             "origin": rec.get("origin"),
+            "queue_position": rec.get("queue_position"),
         })
     return rows
 
@@ -880,7 +911,8 @@ class SystemSnapshot:
                  executors_fn: Optional[Callable[[], List[dict]]] = None,
                  tasks_fn: Optional[Callable[[], List[dict]]] = None,
                  stages_fn: Optional[Callable[[], List[dict]]] = None,
-                 sessions_fn: Optional[Callable[[], List[dict]]] = None):
+                 sessions_fn: Optional[Callable[[], List[dict]]] = None,
+                 admission_fn: Optional[Callable[[], List[dict]]] = None):
         self._query_log = query_log
         self._operators = operators
         self._executors_fn = executors_fn or _local_executor_rows
@@ -889,6 +921,9 @@ class SystemSnapshot:
         self._tasks_fn = tasks_fn or _local_tasks_rows
         self._stages_fn = stages_fn or _local_stages_rows
         self._sessions_fn = sessions_fn or _session_rows
+        # admission plane: the scheduler wires its controller's decision
+        # ring; standalone has no gate, so the table is empty
+        self._admission_fn = admission_fn or (lambda: [])
 
     def table_rows(self, table: str) -> List[dict]:
         if table not in SYSTEM_SCHEMAS:
@@ -909,6 +944,8 @@ class SystemSnapshot:
             return self._stages_fn()
         if table == "system.sessions":
             return self._sessions_fn()
+        if table == "system.admission":
+            return self._admission_fn()
         return settings_rows()
 
 

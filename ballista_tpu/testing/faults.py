@@ -54,6 +54,12 @@ shuffle.stream.chunk consumer-side chunk receive, per chunk (fail =
 dataplane.flow       server-side chunk-stream writer, per chunk (drop =
                      close mid-stream like a crashed peer; fail =
                      tagged error frame to the reader)
+scheduler.admit      admission gate on ExecuteQuery (fail = the
+                     submission is shed with a structured retryable
+                     error; clients honoring retry-after resubmit)
+scheduler.admission_queue  admission queue pump (fail = this pump round
+                     is skipped and the next retries — a queue fault
+                     may delay dispatch, never lose a submission)
 ==================== =======================================================
 
 Disabled cost: one module-global ``is None`` check per hit — the
@@ -89,6 +95,11 @@ FAULT_POINTS: Dict[str, str] = {
                             "streaming shuffle fetch",
     "dataplane.flow": "server-side chunk-stream writer (drop = close "
                       "mid-stream)",
+    "scheduler.admit": "admission gate on ExecuteQuery (fail = the "
+                       "submission is shed with a retryable error)",
+    "scheduler.admission_queue": "admission queue pump (fail = skip "
+                                 "this round, the next pump retries; "
+                                 "delay = stalled dispatch)",
 }
 
 

@@ -1,0 +1,202 @@
+"""Multi-tenant serving benchmark: K concurrent sessions against ONE
+warm LocalCluster (ROADMAP item 4c).
+
+Each session is its own ``BallistaContext`` (own ``session.id``, so the
+admission plane and ``system.sessions`` metering see real tenants)
+running a mixed TPC-H workload (q1/q3/q5/q12/q16/q18, rotated per
+session so the plan-shape interleaving differs across tenants) through
+the admission gate. Prints ONE JSON line:
+
+    {"metric": "serving_qps", "value": <queries/s>,
+     "serving_p50_seconds": ..., "serving_p99_seconds": ...,
+     "serving_sheds": ..., "serving_errors": ..., ...}
+
+``dev/check_bench_regress.py`` gates serving_qps (higher), the latency
+percentiles (lower) and serving_errors (must stay 0) between rounds.
+
+Usage:
+    python bench_serving.py [--scale 0.05] [--data DIR] [--sessions 4]
+                            [--queries-per-session 6] [--executors 2]
+                            [--slots 2] [--max-running 4]
+                            [--session-quota 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+QUERY_MIX = ("q1", "q3", "q5", "q12", "q16", "q18")
+
+
+def _percentile(sorted_vals, p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(round(p * (len(sorted_vals) - 1))),
+              len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def run_serving(data_dir: str, sessions: int = 4,
+                queries_per_session: int = 6, executors: int = 2,
+                slots: int = 2, max_running: int = 4,
+                session_quota: int = 2, job_timeout: float = 600.0,
+                mix=QUERY_MIX) -> dict:
+    """The measured phase: warm the cluster (one pass over the mix on a
+    warmup session — jit compiles amortize exactly like a long-lived
+    serving deployment), then storm it with K concurrent sessions and
+    report latency percentiles, throughput and admission decisions."""
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.distributed.executor import LocalCluster
+    from ballista_tpu.errors import AdmissionRejected
+    from benchmarks.tpch.schema_def import register_tpch
+
+    qdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "tpch", "queries")
+    sqls = {q: open(os.path.join(qdir, f"{q}.sql")).read() for q in mix}
+
+    cluster = LocalCluster(num_executors=executors,
+                           concurrent_tasks=slots)
+    try:
+        # -- warm pass: one unloaded run of every mix query ----------------
+        warm_ctx = BallistaContext.remote(
+            "localhost", cluster.port,
+            **{"job.timeout": str(job_timeout),
+               "session.id": "serving-warmup"})
+        register_tpch(warm_ctx, data_dir, "tbl")
+        solo = {}
+        for q in mix:
+            t0 = time.time()
+            warm_ctx.sql(sqls[q]).collect()
+            solo[q] = round(time.time() - t0, 4)
+
+        # -- the storm -----------------------------------------------------
+        svc = cluster.service
+        admitted0 = svc.admission.admitted_total
+        sheds0 = svc.admission.sheds_total
+        latencies: list = []
+        errors: list = []
+        lat_lock = threading.Lock()
+        peak_queue = [0]
+        stop = threading.Event()
+
+        def watch_queue():
+            while not stop.is_set():
+                peak_queue[0] = max(peak_queue[0],
+                                    svc.admission.queue_depth())
+                time.sleep(0.05)
+
+        watcher = threading.Thread(target=watch_queue, daemon=True)
+        watcher.start()
+
+        def run_session(idx: int):
+            settings = {
+                "job.timeout": str(job_timeout),
+                "session.id": f"serving-{idx}",
+                "admission.max_running_jobs": str(max_running),
+                "admission.max_session_jobs": str(session_quota),
+            }
+            ctx = BallistaContext.remote("localhost", cluster.port,
+                                         **settings)
+            register_tpch(ctx, data_dir, "tbl")
+            for j in range(queries_per_session):
+                q = mix[(idx + j) % len(mix)]
+                t0 = time.time()
+                try:
+                    ctx.sql(sqls[q]).collect()
+                except AdmissionRejected as e:
+                    # terminal shed (client retries exhausted): counted
+                    # separately — not an engine error
+                    with lat_lock:
+                        errors.append((q, f"shed:{e.reason}"))
+                except Exception as e:  # noqa: BLE001 - recorded
+                    with lat_lock:
+                        errors.append((q, f"{type(e).__name__}: {e}"))
+                else:
+                    with lat_lock:
+                        latencies.append((q, time.time() - t0))
+
+        threads = [threading.Thread(target=run_session, args=(i,))
+                   for i in range(sessions)]
+        t0 = time.time()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.time() - t0
+        stop.set()
+        watcher.join(1)
+
+        lats = sorted(s for _, s in latencies)
+        per_query = {}
+        for q, s in latencies:
+            per_query.setdefault(q, []).append(s)
+        result = {
+            "metric": "serving_qps",
+            "unit": "queries/s",
+            "value": round(len(lats) / wall, 3) if wall > 0 else 0.0,
+            "serving_wall_seconds": round(wall, 3),
+            "serving_sessions": sessions,
+            "serving_queries": sessions * queries_per_session,
+            "serving_completed": len(lats),
+            "serving_errors": len([e for e in errors
+                                   if not e[1].startswith("shed:")]),
+            "serving_sheds": (svc.admission.sheds_total - sheds0),
+            "serving_admitted": (svc.admission.admitted_total
+                                 - admitted0),
+            "serving_peak_queue_depth": peak_queue[0],
+            "serving_p50_seconds": round(_percentile(lats, 0.50), 4),
+            "serving_p99_seconds": round(_percentile(lats, 0.99), 4),
+            "serving_max_seconds": round(lats[-1], 4) if lats else 0.0,
+            "serving_solo_seconds": solo,
+            "serving_query_p50": {
+                q: round(_percentile(sorted(v), 0.5), 4)
+                for q, v in sorted(per_query.items())},
+        }
+        if errors:
+            result["serving_error_sample"] = str(errors[:3])[:300]
+        return result
+    finally:
+        cluster.shutdown()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=0.05)
+    ap.add_argument("--data", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks",
+        "data_serving"))
+    ap.add_argument("--sessions", type=int, default=4)
+    ap.add_argument("--queries-per-session", type=int, default=6)
+    ap.add_argument("--executors", type=int, default=2)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--max-running", type=int, default=4)
+    ap.add_argument("--session-quota", type=int, default=2)
+    args = ap.parse_args()
+
+    from benchmarks.tpch import datagen
+
+    data_dir = os.path.join(args.data, f"sf{args.scale}")
+    marker = os.path.join(data_dir, ".complete")
+    if not os.path.exists(marker):
+        print(f"# generating TPC-H SF{args.scale} into {data_dir}",
+              file=sys.stderr)
+        datagen.generate(data_dir, scale=args.scale, num_parts=2)
+        open(marker, "w").write("ok\n")
+
+    result = run_serving(
+        data_dir, sessions=args.sessions,
+        queries_per_session=args.queries_per_session,
+        executors=args.executors, slots=args.slots,
+        max_running=args.max_running,
+        session_quota=args.session_quota)
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,6 +83,18 @@ METRICS: Dict[str, Tuple[str, float]] = {
     "shuffle_peak_inflight_mb": ("lower", 0.50),
     "spill_q5_seconds": ("lower", 0.50),
     "spill_q5_peak_rss_mb": ("lower", 0.35),
+    # PR 15 (admission plane): bench_serving.py — K concurrent mixed
+    # TPC-H sessions against one warm LocalCluster. Throughput rides
+    # "value" (higher) in that file; the latency percentiles must not
+    # silently regrow round-over-round, and an engine error during the
+    # storm (sheds are counted separately and are policy, not errors)
+    # shows up as serving_completed dropping to 0.
+    "serving_p50_seconds": ("lower", 0.40),
+    "serving_p99_seconds": ("lower", 0.50),
+    "serving_completed": ("nonzero", 0.0),
+    # engine errors during the storm must stay ZERO (sheds are counted
+    # separately — they are policy, not errors)
+    "serving_errors": ("zero", 0.0),
 }
 
 
@@ -152,6 +164,13 @@ def compare(old: dict, new: dict, tolerance_scale: float = 1.0) -> list:
             # aliveness gate: regress only when a previously-reporting
             # metric reads 0 now (magnitude is wall-time-coupled noise)
             regressed = o > 0 and n <= 0
+            rows.append((metric, o, n, 1.0 if regressed else 0.0,
+                         regressed, True))
+            continue
+        if direction == "zero":
+            # hard-zero gate: any nonzero NEW value regresses (the old
+            # value is irrelevant — errors are never acceptable)
+            regressed = n > 0
             rows.append((metric, o, n, 1.0 if regressed else 0.0,
                          regressed, True))
             continue
@@ -231,6 +250,14 @@ def self_test() -> int:
                          "spill_budget_mb": 8.0,
                          "spill_chunk_mb": 1.0}) == 1
     assert budget_check({}) == 0
+    # zero metrics: ANY nonzero new value regresses, improvement to 0
+    # never does
+    rows = {r[0]: r for r in compare({"serving_errors": 0},
+                                     {"serving_errors": 2})}
+    assert rows["serving_errors"][4] is True
+    rows = {r[0]: r for r in compare({"serving_errors": 3},
+                                     {"serving_errors": 0})}
+    assert rows["serving_errors"][4] is False
     print("self-test ok")
     return 0
 

@@ -305,6 +305,25 @@ def apply_spill(fdp: dp.FileDescriptorProto) -> None:
     add_field(res, "spill_bytes_total", 7, F.TYPE_UINT64)
 
 
+def apply_admission(fdp: dp.FileDescriptorProto) -> None:
+    """PR 15: multi-tenant admission plane (mirrored by hand in
+    ballista.proto; dev/check_proto_sync.py guards the drift) — the
+    structured shed on ExecuteQueryResult, queue position/reason on the
+    queued JobStatus, and the retryable retry-after on FailedJob
+    (queue-timeout sheds travel as a terminal failed status)."""
+    res = get_message(fdp, "ExecuteQueryResult")
+    add_field(res, "error", 2, F.TYPE_STRING)
+    add_field(res, "retry_after_secs", 3, F.TYPE_DOUBLE)
+
+    q = get_message(fdp, "QueuedJob")
+    add_field(q, "queue_position", 1, F.TYPE_UINT32)
+    add_field(q, "reason", 2, F.TYPE_STRING)
+    add_field(q, "queued_seconds", 3, F.TYPE_DOUBLE)
+
+    add_field(get_message(fdp, "FailedJob"), "retry_after_secs", 2,
+              F.TYPE_DOUBLE)
+
+
 TEMPLATE = '''# -*- coding: utf-8 -*-
 # Generated by dev/gen_proto_patch.py (no protoc in this image). DO NOT EDIT!
 # source: ballista.proto
@@ -339,6 +358,7 @@ def main() -> None:
     apply_lifecycle(fdp)
     apply_progress(fdp)
     apply_spill(fdp)
+    apply_admission(fdp)
     out = TEMPLATE.format(blob=fdp.SerializeToString())
     with open(PB2, "w") as f:
         f.write(out)

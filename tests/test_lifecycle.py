@@ -745,6 +745,13 @@ CHAOS_SEEDS = {
     "spill-write-fail": ("shuffle.spill.write=fail-once", {},
                          {"BALLISTA_SHUFFLE_MEM_BUDGET": "4096",
                           "BALLISTA_SHUFFLE_CHUNK_BYTES": "1024"}, True),
+    # admission plane (PR 15): a gate fault sheds the submission with a
+    # structured retryable error; remote_collect honors the retry-after
+    # and the resubmission completes byte-identical. A gate delay just
+    # slows ExecuteQuery. (Queue-pump faults are exercised by the
+    # overload sweep in test_admission.py, where a queue exists.)
+    "admit-fail-once": ("scheduler.admit=fail-once", {}, {}, True),
+    "admit-delay": ("scheduler.admit=delay:100", {}, {}, True),
 }
 
 
