@@ -37,10 +37,15 @@ queue-wait histogram, and ``system.admission`` rows; the
 ``scheduler.admit`` / ``scheduler.admission_queue`` fault points feed
 the chaos overload sweep (tests/test_admission.py).
 
-The queue is in-memory scheduler state: a restarted scheduler drops
-queued (never-admitted) submissions — their waiting clients see an
-unknown-job error and resubmit, the same contract a lost ExecuteQuery
-already has.
+The queue itself is in-memory scheduler state, but every accepted
+submission is ALSO journaled through the control plane
+(distributed/controlplane/journal.py) at decision time: a scheduler
+restarted against a durable backend rebuilds queued (never-admitted)
+submissions — priority, deadline and original enqueue time preserved
+— in its ``recover()`` pass, marked ``recovered`` in queue-info and
+GetJobStatus. Only a memory-backed (or journal-degraded) scheduler
+keeps the old contract: queued submissions drop and their waiting
+clients see an unknown-job error and resubmit.
 """
 
 from __future__ import annotations
@@ -161,6 +166,9 @@ class Decision:
     deadline_ts: Optional[float] = None
     enqueued_at: float = 0.0
     args: Optional[tuple] = None  # held planning args for queued jobs
+    # rebuilt from the control-plane journal by a restarted scheduler's
+    # recover() pass (GetJobStatus surfaces it as QueuedJob.recovered)
+    recovered: bool = False
 
     def error(self) -> AdmissionRejected:
         return AdmissionRejected(self.reason, self.retry_after_secs,
@@ -393,9 +401,24 @@ class AdmissionController:
         direct-constructed decisions (tests, tools) are inserted here."""
         with self._lock:
             decision.args = args
-            if not any(d is decision for d in self._queue):
+            # dedup by job_id, not identity: a repeated recovery pass
+            # rebuilds fresh Decision objects for jobs already waiting
+            if not any(d.job_id == decision.job_id for d in self._queue):
                 self._queue.append(decision)
                 self._sort_locked()
+
+    def restore_admitted(self, job_id: str, session_id: str) -> None:
+        """Restart recovery: re-occupy the concurrency slot of a job
+        that was ADMITTED before the previous scheduler died (in-flight
+        or replayed planning), so post-restart pumping still honors
+        ``max_running_jobs``/``max_session_jobs`` and the job's terminal
+        transition releases a slot that actually exists."""
+        with self._lock:
+            if job_id in self._active_session:
+                return
+            self._active_session[job_id] = session_id
+            self._session_jobs[session_id] = \
+                self._session_jobs.get(session_id, 0) + 1
 
     def _sort_locked(self) -> None:
         # priority (higher first), then server-side deadline (sooner
@@ -436,6 +459,7 @@ class AdmissionController:
                         "queue_position": i + 1,
                         "reason": d.reason,
                         "queued_seconds": round(now - d.enqueued_at, 3),
+                        "recovered": d.recovered,
                     }
         return None
 

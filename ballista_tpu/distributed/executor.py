@@ -405,6 +405,13 @@ class Executor:
             raise
         for job_id in result.cancelled_jobs:
             self._handle_job_cancelled(job_id)
+        if result.drain and not self._draining:
+            # autoscaler scale-down piggyback: stop accepting work; the
+            # poll loop keeps reporting until in-flight tasks finish
+            # (executor_main exits on its own drain path afterwards)
+            log.warning("executor %s: scheduler requested drain; no "
+                        "longer accepting tasks", self.id[:8])
+            self._draining = True
         if result.HasField("task"):
             self._run_task(result.task)
 
@@ -825,36 +832,69 @@ class LocalCluster:
     def __init__(self, num_executors: int = 2, concurrent_tasks: int = 2,
                  scheduler_port: int = 0, num_devices: int = 1,
                  speculation_age_secs: float = 60.0,
-                 metrics_port: "int | None" = None):
+                 metrics_port: "int | None" = None,
+                 backend=None):
         from .scheduler import serve_scheduler
         from .state import MemoryBackend, SchedulerState
 
         # metrics_port: None = off (in-process test clusters shouldn't
         # bind sockets unasked); 0 = ephemeral health plane on the
         # scheduler AND every executor
-        self.state = SchedulerState(MemoryBackend())
+        # backend: a durable KvBackend (e.g. SqliteBackend) makes this
+        # in-process cluster restart-recoverable — the controlplane
+        # tests rebuild a LocalCluster over the same file
+        self.state = SchedulerState(backend or MemoryBackend())
         self.server, self.service, self.port = serve_scheduler(
             self.state, "localhost", scheduler_port,
             speculation_age_secs=speculation_age_secs,
             metrics_port=metrics_port,
         )
+        # remember the executor shape: the autoscaler's add_executor
+        # hook spawns clones of the launch-time fleet
+        self._exec_kwargs = dict(
+            concurrent_tasks=concurrent_tasks,
+            num_devices=num_devices,
+            # executors always take an ephemeral port (several per
+            # host; a fixed one could only serve the first); a
+            # negative caller value means OFF here too (-1, not
+            # None — None would fall back to the env default and
+            # re-enable what the caller explicitly disabled)
+            metrics_port=(None if metrics_port is None
+                          else 0 if metrics_port >= 0 else -1),
+        )
         self.executors = []
         for _ in range(num_executors):
-            cfg = ExecutorConfig(
-                scheduler_host="localhost", scheduler_port=self.port,
-                concurrent_tasks=concurrent_tasks,
-                num_devices=num_devices,
-                # executors always take an ephemeral port (several per
-                # host; a fixed one could only serve the first); a
-                # negative caller value means OFF here too (-1, not
-                # None — None would fall back to the env default and
-                # re-enable what the caller explicitly disabled)
-                metrics_port=(None if metrics_port is None
-                              else 0 if metrics_port >= 0 else -1),
-            )
-            e = Executor(cfg)
-            e.start()
-            self.executors.append(e)
+            self.add_executor()
+
+    def add_executor(self) -> "Executor":
+        """Spawn one more in-process executor (the autoscaler's
+        LocalCluster scale-up hook)."""
+        cfg = ExecutorConfig(
+            scheduler_host="localhost", scheduler_port=self.port,
+            **self._exec_kwargs,
+        )
+        e = Executor(cfg)
+        e.start()
+        self.executors.append(e)
+        return e
+
+    def remove_executor(self, executor_id: "str | None" = None
+                        ) -> "str | None":
+        """Gracefully drain one executor (the autoscaler's LocalCluster
+        scale-down hook): the youngest, or the one with ``executor_id``.
+        Returns the drained executor's id, or None when empty."""
+        if not self.executors:
+            return None
+        if executor_id is None:
+            e = self.executors.pop()
+        else:
+            match = [x for x in self.executors if x.id == executor_id]
+            if not match:
+                return None
+            e = match[0]
+            self.executors.remove(e)
+        e.stop(drain=True)
+        return e.id
 
     @property
     def scheduler_health_port(self) -> "int | None":

@@ -280,6 +280,21 @@ class SchedulerService:
         self.admission = AdmissionController(
             state=state, launch_fn=self._launch_job,
             shed_fn=self._shed_queued_job)
+        # durable control plane (distributed/controlplane/): accepted
+        # submissions journal through the state's KvBackend at decision
+        # time so a restarted scheduler rebuilds its admission queue and
+        # replays planning lost mid-flight; observed stage costs persist
+        # per plan digest and steer the NEXT initial plan. Both degrade
+        # to in-memory (loudly) on backend errors — never refuse work.
+        from .controlplane import ControlPlaneJournal, CostFeedbackStore
+
+        self.journal = ControlPlaneJournal(state)
+        self.costs = CostFeedbackStore(state)
+        # elasticity: attach_autoscaler() installs the decision loop;
+        # drain_requests carries scale-down targets to their executors
+        # on the next PollWork (PollWorkResult.drain piggyback)
+        self.autoscaler = None
+        self.drain_requests: set = set()
         # merge/render/write of terminal-job artifacts runs here, OFF
         # the RPC handler threads (thread created lazily on first use:
         # unprofiled schedulers never spawn it)
@@ -305,6 +320,7 @@ class SchedulerService:
             tasks_fn=self.progress.task_rows,
             stages_fn=self.progress.stage_rows,
             admission_fn=self.admission.decision_rows,
+            autoscaler_fn=self._autoscaler_rows,
         )
         # system.queries / /debug/queries: queued rows carry their live
         # admission-queue position
@@ -341,6 +357,15 @@ class SchedulerService:
             ("ballista_admission_sheds_total", {},
              self.admission.sheds_total),
         ]
+        if self.autoscaler is not None:
+            out.extend([
+                ("ballista_autoscale_target_executors", {},
+                 self.autoscaler.target),
+                ("ballista_autoscale_ups_total", {},
+                 self.autoscaler.scale_ups_total),
+                ("ballista_autoscale_downs_total", {},
+                 self.autoscaler.scale_downs_total),
+            ])
         # live progress gauges: per-job completion fraction + the
         # cluster-wide running-task count (gated through the registry
         # like every family; live jobs are bounded by the tracker cap)
@@ -408,6 +433,12 @@ class SchedulerService:
             })
         return rows
 
+    def _autoscaler_rows(self):
+        """system.autoscaler rows (empty until attach_autoscaler)."""
+        if self.autoscaler is None:
+            return []
+        return self.autoscaler.decision_rows()
+
     def _debug_jobs(self, job_id: "str | None"):
         """``/debug/jobs`` (job_id None: every live job) and
         ``/debug/jobs/<job_id>`` (live or recently terminal). Queued
@@ -430,9 +461,78 @@ class SchedulerService:
         self.admission.begin_drain()
 
     def close_health(self):
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if self.health is not None:
             self.health.close()
         self._profile_pool.shutdown(wait=False)
+
+    # -- durable control plane ----------------------------------------------
+
+    def recover(self):
+        """One explicit restart-recovery pass over the durable backend
+        (controlplane/recovery.py): re-queue journaled submissions,
+        replay planning lost mid-flight, distrust unroutable shuffle
+        outputs, fail orphans loudly. Call once, BEFORE executors poll.
+        Returns the :class:`RecoveryReport`."""
+        from .controlplane import recover as _recover
+
+        return _recover(self)
+
+    def attach_autoscaler(self, config, spawn_fn, drain_fn=None,
+                          start=True):
+        """Install the demand-driven autoscaler over this scheduler's
+        own signals (ready+admission backlog, in-flight task gauges,
+        live executor count, max live-job ETA). ``drain_fn`` defaults
+        to flagging the least-loaded live executor for a graceful
+        drain via the PollWorkResult piggyback."""
+        from .controlplane import Autoscaler
+
+        def signal_fn():
+            eta = 0.0
+            try:
+                for s in self.progress.live_snapshots():
+                    eta = max(eta, float(s.get("eta_seconds") or 0.0))
+            except Exception:  # noqa: BLE001 - advisory signal
+                pass
+            metas = self.state.get_executors_metadata()
+            inflight = 0
+            for m in metas:
+                res = getattr(m, "resources", None) or {}
+                inflight += int(res.get("inflight_tasks") or 0)
+            return {
+                "backlog": self.state.ready_queue_depth()
+                + self.admission.queue_depth(),
+                "inflight": inflight,
+                "executors": len(metas),
+                "eta_seconds": eta,
+            }
+
+        if drain_fn is None:
+            drain_fn = self._drain_one_executor
+        self.autoscaler = Autoscaler(config, signal_fn, spawn_fn,
+                                     drain_fn)
+        if start:
+            self.autoscaler.start()
+        return self.autoscaler
+
+    def _drain_one_executor(self):
+        """Default scale-down hook: flag the least-loaded live executor
+        not already draining; its next PollWork carries ``drain=True``
+        and the executor stops accepting tasks, exiting via its own
+        drain path once idle."""
+        metas = self.state.get_executors_metadata()
+        candidates = [m for m in metas if m.id not in self.drain_requests]
+        if not candidates:
+            return None
+
+        def load(m):
+            res = getattr(m, "resources", None) or {}
+            return int(res.get("inflight_tasks") or 0)
+
+        target = min(candidates, key=load)
+        self.drain_requests.add(target.id)
+        return target.id
 
     # -- distributed profiler ------------------------------------------------
 
@@ -464,6 +564,9 @@ class SchedulerService:
         except Exception:  # noqa: BLE001 - must not take the job down
             log.exception("admission terminal hook failed for job %s",
                           job_id)
+        # durable control plane: the submission record is spent — a
+        # restart must not resurrect a terminal job (internally guarded)
+        self.journal.drop_submission(job_id)
         # live progress: freeze the final snapshot (fraction exactly
         # 1.0 for completed jobs) and drop the job's sample store
         try:
@@ -518,6 +621,18 @@ class SchedulerService:
             except Exception:  # noqa: BLE001 - observability only
                 log.exception("session metering failed for job %s",
                               job_id)
+            # cost feedback: fold the completed job's observed stage
+            # costs into its plan digest's record (the next submission
+            # of this shape plans from them). Off the PollWork thread
+            # like the session meter — it rewrites a durable row.
+            if status.state == "completed" and sm:
+                try:
+                    self.costs.observe(
+                        summary.get("plan_digest") or "", sm,
+                        wall_seconds=wall)
+                except Exception:  # noqa: BLE001 - advisory
+                    log.exception("cost observe failed for job %s",
+                                  job_id)
             try:
                 art = path = None
                 if want_artifact:
@@ -629,17 +744,20 @@ class SchedulerService:
             return pb.ExecuteQueryResult(
                 job_id=job_id, error=str(err),
                 retry_after_secs=err.retry_after_secs)
+        deadline_ts = None
         if request.deadline_secs > 0:
             # server-side deadline: armed BEFORE planning (a stuck plan
             # counts — and an admission-QUEUED job's wait counts too)
             # and enforced by the PollWork reap pass, so the job dies
             # on time even when the submitting client is gone
-            self.state.save_job_deadline(
-                job_id, time.time() + request.deadline_secs)
+            deadline_ts = time.time() + request.deadline_secs
+            self.state.save_job_deadline(job_id, deadline_ts)
         try:
             if request.WhichOneof("query") == "logical_plan":
                 plan = serde.plan_from_proto(request.logical_plan)
                 args = (job_id, plan, settings, None, None)
+                plan_bytes = request.logical_plan.SerializeToString()
+                sql_text, catalog_bytes = None, None
             else:
                 # raw SQL: planned server-side in the background thread
                 # (like plan failures, SQL errors land in
@@ -647,10 +765,25 @@ class SchedulerService:
                 # error; reference accepts sql-or-plan, lib.rs:236-247)
                 args = (job_id, None, settings, request.sql,
                         list(request.catalog))
+                plan_bytes = None
+                sql_text = request.sql
+                catalog_bytes = [ct.SerializeToString()
+                                 for ct in request.catalog]
             self.state.save_job_status(job_id, JobStatus("queued"))
             # live progress: track from submission so /debug/jobs
             # answers during planning too (fraction 0, no stages yet)
             self.progress.register_job(job_id)
+            # durable control plane: journal the accepted submission at
+            # decision time — a restarted scheduler re-queues (queued)
+            # or replays planning (admitted, crashed mid-plan) from
+            # exactly this record. Advisory: degrades loudly in-memory.
+            self.journal.record_submission(
+                job_id, decision.session_id, settings,
+                sql=sql_text, catalog=catalog_bytes,
+                plan_bytes=plan_bytes,
+                action=decision.action, reason=decision.reason,
+                priority=decision.config.priority,
+                deadline_ts=deadline_ts)
         except BaseException:
             # the submission dies before it exists (bad plan proto):
             # release the gate's reservation or the session leaks a
@@ -752,16 +885,30 @@ class SchedulerService:
         self.state.save_job_settings(job_id, settings or {})
         if logical_plan is None:
             logical_plan = self._plan_sql(sql, catalog_entries or [])
+        digest = None
         try:
             # plan digest: identifies the query in slow-query summaries
-            # and profile artifacts without re-planning it
+            # and profile artifacts without re-planning it — and keys
+            # the cost-feedback store below
             from ..observability.profiler import plan_digest
 
-            self.state.save_job_digest(job_id, plan_digest(logical_plan))
+            digest = plan_digest(logical_plan)
+            self.state.save_job_digest(job_id, digest)
         except Exception:  # noqa: BLE001 - digest is advisory
             pass
-        phys = plan_logical(logical_plan,
-                            PlannerOptions.from_settings(settings))
+        opts = PlannerOptions.from_settings(settings)
+        try:
+            # cost feedback: observed costs from prior runs of this
+            # plan shape refine the INITIAL partition counts and join
+            # strategy (AQE still corrects mid-flight; explicit client
+            # settings always win inside advise)
+            opts, cost_notes = self.costs.advise(digest, opts, settings)
+            if cost_notes:
+                log.info("cost feedback for job %s: %s", job_id,
+                         "; ".join(cost_notes))
+        except Exception:  # noqa: BLE001 - advisory
+            log.exception("cost advise failed for job %s", job_id)
+        phys = plan_logical(logical_plan, opts)
         stages = DistributedPlanner().plan_query_stages(job_id, phys)
         stages = _fuse_mesh_stages(
             stages, _cluster_mesh_devices(self.state, settings)
@@ -797,6 +944,10 @@ class SchedulerService:
                      job_id)
             return
         self.state.enqueue_job(job_id)
+        # durable control plane: the full stage set + task rows are
+        # persisted and the ready stages enqueued — restart recovery
+        # may now trust them (absent marker ⇒ planning replays)
+        self.journal.mark_planned(job_id)
         log.info(
             "planned job %s into %d stages in %.0fms",
             job_id, len(stages), 1000 * (time.time() - t0),
@@ -916,7 +1067,13 @@ class SchedulerService:
             else:
                 self.state.save_task_status(st)
         result = pb.PollWorkResult()
-        if request.can_accept_task:
+        # autoscaler scale-down: tell a flagged executor to stop
+        # accepting work (it drains its in-flight tasks and exits via
+        # its own graceful path) — and don't hand it a task this poll
+        draining = meta.id in self.drain_requests
+        if draining:
+            result.drain = True
+        if request.can_accept_task and not draining:
             task = self.state.next_task(meta.num_devices)
             if task is None and self.speculation_age_secs > 0:
                 task = self.state.speculative_task(
@@ -1049,6 +1206,8 @@ class SchedulerService:
                 result.status.queued.reason = info["reason"] or ""
                 result.status.queued.queued_seconds = \
                     info["queued_seconds"]
+                result.status.queued.recovered = \
+                    bool(info.get("recovered"))
         elif st.state == "running":
             result.status.running.SetInParent()
         elif st.state == "cancelled":

@@ -25,6 +25,13 @@ DEFAULTS = {
     "flight_port": -1,  # Arrow Flight SQL front-end; -1 = off, 0 = ephemeral
     "metrics_port": 0,  # health plane (/healthz, /metrics); -1 = off
     "log_level": "INFO",
+    # durable control plane: --state sqlite:/path or etcd:host:port is
+    # shorthand for config_backend + its path/urls in one flag
+    "state": "",
+    # demand-driven autoscaler (off unless on): spawns/drains
+    # executor_main subprocesses against this scheduler; bounds and
+    # thresholds ride the autoscale.* knob family (BALLISTA_AUTOSCALE_*)
+    "autoscale": "off",
 }
 
 
@@ -46,6 +53,14 @@ def main(argv=None) -> int:
         "scheduler", DEFAULTS, args.config_file,
         cli={k: getattr(args, k) for k in DEFAULTS},
     )
+    if cfg["state"]:
+        # --state sqlite:<path> | etcd:<urls> | memory
+        kind, _, rest = str(cfg["state"]).partition(":")
+        cfg["config_backend"] = kind
+        if kind == "sqlite" and rest:
+            cfg["sqlite_path"] = rest
+        elif kind == "etcd" and rest:
+            cfg["etcd_urls"] = rest
     backends = ("memory", "sqlite", "etcd")
     if cfg["config_backend"] not in backends:
         # validate post-layering so env/TOML typos fail loudly instead of
@@ -77,6 +92,35 @@ def main(argv=None) -> int:
     print(f"ballista-tpu scheduler listening on {cfg['bind_host']}:{port} "
           f"(backend={cfg['config_backend']}, ns={cfg['namespace']})",
           flush=True)
+    # restart recovery: one explicit pass BEFORE executors poll — a
+    # durable backend rebuilds the admission queue, replays planning
+    # lost mid-flight and fails orphans loudly (memory backend: no-op)
+    report = _svc.recover()
+    print("control-plane recovery: "
+          f"recovered_jobs={report.recovered_jobs} "
+          f"queued_restored={report.queued_restored} "
+          f"relaunched={report.relaunched} "
+          f"inflight={report.jobs_inflight} "
+          f"orphans_failed={report.orphans_failed} "
+          f"tasks_requeued={report.tasks_requeued} "
+          f"seconds={report.recovery_seconds}", flush=True)
+    launcher = None
+    if str(cfg["autoscale"]).lower() in ("on", "1", "true", "yes"):
+        from .controlplane import (AutoscalerConfig,
+                                   SubprocessExecutorLauncher)
+
+        as_cfg = AutoscalerConfig.from_settings({"autoscale.enabled":
+                                                 "on"})
+        loop_host = ("127.0.0.1"
+                     if cfg["bind_host"] in ("0.0.0.0", "::", "localhost",
+                                             "127.0.0.1")
+                     else cfg["bind_host"])
+        launcher = SubprocessExecutorLauncher(loop_host, port)
+        _svc.attach_autoscaler(as_cfg, launcher.spawn,
+                               drain_fn=launcher.drain)
+        print(f"autoscaler on: executors {as_cfg.min_executors}.."
+              f"{as_cfg.max_executors} (backlog>={as_cfg.backlog_tasks}"
+              f", cooldown={as_cfg.cooldown_secs}s)", flush=True)
     if _svc.health is not None:
         print(f"ballista-tpu scheduler health plane on "
               f"127.0.0.1:{_svc.health.port}", flush=True)
@@ -137,8 +181,11 @@ def main(argv=None) -> int:
             _time.sleep(0.25)
     else:
         print(f"signal {stop}; shutting down", flush=True)
+    if launcher is not None:
+        launcher.stop_all()
     if flight_server is not None:
         flight_server.shutdown()
+    _svc.close_health()
     server.stop(grace=2)
     return 0
 

@@ -116,7 +116,13 @@ class SqliteBackend(KvBackend):
         conn = getattr(self._tls, "conn", None)
         if conn is None:
             conn = sqlite3.connect(self._path, timeout=30)
+            # crash atomicity: WAL keeps readers unblocked; FULL makes
+            # each commit durable before the statement returns, so a
+            # SIGKILLed writer leaves whole committed rows or nothing —
+            # never a torn record (the restart-recovery contract)
             conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=FULL")
+            conn.execute("PRAGMA busy_timeout=30000")
             self._tls.conn = conn
         return conn
 
@@ -250,6 +256,9 @@ class SchedulerState:
         stage rows, and the ready-queue from tasks that were pending when
         the previous scheduler died (running tasks are re-queued too — the
         old executor's completion report would be lost)."""
+        # chaos surface: a backend read fault here is a restart against
+        # a flaky store — the scheduler serves with whatever loaded
+        fault_point("state.load", ns=self.ns)
         stage_rows = self.kv.get_from_prefix(self._k("stages"))
         if not stage_rows:
             return

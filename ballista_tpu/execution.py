@@ -120,7 +120,9 @@ def plan_logical(plan: LogicalPlan, options=None) -> PhysicalPlan:
                 phys, plan.verbose, opt.pretty(),
                 getattr(options, "adaptive_settings", None))
         return render_explain(opt, phys, plan.verbose,
-                              unoptimized_text=unopt)
+                              unoptimized_text=unopt,
+                              cost_notes=getattr(options, "cost_notes",
+                                                 None))
     plan = resolve_scalar_subqueries(plan, options)
     return create_physical_plan(optimize(plan), options)
 

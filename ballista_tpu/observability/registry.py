@@ -163,6 +163,16 @@ PROCESS_METRICS = {
                                               "the admission queue "
                                               "(label outcome=admitted|"
                                               "shed)"),
+    # autoscaler (scheduler; distributed/controlplane/autoscaler.py)
+    "ballista_autoscale_target_executors": ("gauge", "fleet size the "
+                                                     "autoscaler is "
+                                                     "steering toward"),
+    "ballista_autoscale_ups_total": ("counter", "scale-up decisions "
+                                                "acted on (executor "
+                                                "spawned)"),
+    "ballista_autoscale_downs_total": ("counter", "scale-down decisions "
+                                                  "acted on (executor "
+                                                  "drained)"),
 }
 
 # -- process-level histograms -------------------------------------------------

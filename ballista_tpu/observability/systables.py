@@ -210,6 +210,14 @@ KNOBS: Dict[str, tuple] = {
                                        "resubmit within the job "
                                        "timeout; off = raise "
                                        "immediately)"),
+    "BALLISTA_CONTROLPLANE_COST_FEEDBACK": (
+        "on", "planner consults persisted per-digest stage costs for "
+              "initial partition counts and join strategy (off = "
+              "static defaults; AQE still corrects mid-flight)"),
+    "BALLISTA_CONTROLPLANE_COST_TARGET_PARTITION_BYTES": (
+        "67108864", "cost feedback sizes shuffle partition counts so "
+                    "each partition carries about this many observed "
+                    "shuffle bytes"),
 }
 
 # dynamic env-name families: read via computed names, documented as
@@ -225,6 +233,13 @@ KNOB_PREFIXES: Dict[str, str] = {
                            "(distributed/admission.py; quotas, "
                            "saturation bound, queue timeout — see "
                            "docs/robustness.md)",
+    "BALLISTA_AUTOSCALE_": "autoscale.* setting fallbacks "
+                           "(distributed/controlplane/autoscaler.py; "
+                           "fleet bounds, backlog/ETA thresholds, "
+                           "cooldown — see docs/robustness.md)",
+    "BALLISTA_CONTROLPLANE_": "controlplane.* setting fallbacks "
+                              "(distributed/controlplane/; cost "
+                              "feedback — see docs/robustness.md)",
 }
 
 
@@ -329,6 +344,15 @@ SYSTEM_SCHEMAS: Dict[str, Schema] = {
         ("reason", Utf8), ("priority", Float64),
         ("cluster_load", Int64), ("queue_wait_seconds", Float64),
         ("retry_after_seconds", Float64), ("decided_at", Float64),
+    ),
+    # elastic control plane (distributed/controlplane/autoscaler.py):
+    # recent scale-up/scale-down decisions — the scheduler's ring on
+    # the cluster path, empty standalone or with the autoscaler off
+    "system.autoscaler": make_schema(
+        ("decided_at", Float64), ("action", Utf8), ("reason", Utf8),
+        ("executors", Int64), ("target", Int64), ("backlog", Int64),
+        ("inflight_tasks", Int64), ("eta_seconds", Float64),
+        ("drained", Utf8),
     ),
 }
 
@@ -912,7 +936,8 @@ class SystemSnapshot:
                  tasks_fn: Optional[Callable[[], List[dict]]] = None,
                  stages_fn: Optional[Callable[[], List[dict]]] = None,
                  sessions_fn: Optional[Callable[[], List[dict]]] = None,
-                 admission_fn: Optional[Callable[[], List[dict]]] = None):
+                 admission_fn: Optional[Callable[[], List[dict]]] = None,
+                 autoscaler_fn: Optional[Callable[[], List[dict]]] = None):
         self._query_log = query_log
         self._operators = operators
         self._executors_fn = executors_fn or _local_executor_rows
@@ -924,6 +949,9 @@ class SystemSnapshot:
         # admission plane: the scheduler wires its controller's decision
         # ring; standalone has no gate, so the table is empty
         self._admission_fn = admission_fn or (lambda: [])
+        # elastic control plane: the scheduler wires its autoscaler's
+        # decision ring; standalone never autoscales, so empty
+        self._autoscaler_fn = autoscaler_fn or (lambda: [])
 
     def table_rows(self, table: str) -> List[dict]:
         if table not in SYSTEM_SCHEMAS:
@@ -946,6 +974,8 @@ class SystemSnapshot:
             return self._sessions_fn()
         if table == "system.admission":
             return self._admission_fn()
+        if table == "system.autoscaler":
+            return self._autoscaler_fn()
         return settings_rows()
 
 

@@ -196,12 +196,15 @@ def make_explain_analyze(inner: PhysicalPlan, verbose: bool,
 
 def render_explain(logical_input, physical_input: PhysicalPlan,
                    verbose: bool,
-                   unoptimized_text: str | None = None) -> ExplainExec:
+                   unoptimized_text: str | None = None,
+                   cost_notes: "tuple | None" = None) -> ExplainExec:
     """Build the EXPLAIN result rows from planned inputs.
 
     Non-verbose mirrors the two-row (logical_plan, physical_plan) surface;
     verbose additionally shows the pre-optimization logical plan when the
-    caller captured one.
+    caller captured one. ``cost_notes`` (the control plane's
+    cost-feedback decisions for this plan shape) render as one extra
+    ``cost_feedback`` row so planning history stays explainable.
     """
     from .fusion import maybe_fuse
 
@@ -213,4 +216,6 @@ def render_explain(logical_input, physical_input: PhysicalPlan,
     # standalone collect path will actually execute (text-only: the
     # fused operators never serialize)
     rows.append(("physical_plan", maybe_fuse(physical_input).pretty()))
+    if cost_notes:
+        rows.append(("cost_feedback", "\n".join(cost_notes)))
     return ExplainExec(rows)

@@ -71,6 +71,10 @@ class PlannerOptions:
     # from here so analyzed plans run (and annotate) the same adaptive
     # rules a plain collect would
     adaptive_settings: Optional[Dict[str, str]] = None
+    # cost-feedback decisions applied to these options (set by
+    # controlplane.costs.advise); EXPLAIN renders them as a
+    # cost_feedback row so history-informed plans stay explainable
+    cost_notes: tuple = ()
 
     @staticmethod
     def from_settings(settings: Optional[Dict[str, str]]) -> "PlannerOptions":
@@ -245,6 +249,6 @@ def _create(plan: LogicalPlan, opts: PlannerOptions) -> PhysicalPlan:
                 create_physical_plan(plan.input), plan.verbose,
                 plan.input.pretty(), opts.adaptive_settings)
         return render_explain(plan.input, create_physical_plan(plan.input),
-                              plan.verbose)
+                              plan.verbose, cost_notes=opts.cost_notes)
 
     raise NotImplementedError_(f"no physical plan for {type(plan).__name__}")

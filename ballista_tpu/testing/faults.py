@@ -40,6 +40,9 @@ shuffle.fetch        consumer-side shuffle fetch, per attempt (tagged
 dataplane.serve      data-plane request handler (drop = close without a
                      response; fail = error response)
 state.save           scheduler state task-status persistence
+state.load           scheduler state rehydration read at construction
+                     (fail = a restarted scheduler's recovery scan
+                     degrades — serves with whatever loaded)
 client.rpc           every SchedulerClient RPC, client side
 scheduler.progress_report  executor-side TaskProgress piggyback assembly
                      (drop = skip this round's samples, delay = stall
@@ -60,6 +63,9 @@ scheduler.admit      admission gate on ExecuteQuery (fail = the
 scheduler.admission_queue  admission queue pump (fail = this pump round
                      is skipped and the next retries — a queue fault
                      may delay dispatch, never lose a submission)
+autoscaler.spawn     autoscaler scale-up hook, before the spawn (fail =
+                     this tick is skipped; the demand signal persists
+                     so the next tick retries)
 ==================== =======================================================
 
 Disabled cost: one module-global ``is None`` check per hit — the
@@ -86,6 +92,7 @@ FAULT_POINTS: Dict[str, str] = {
     "shuffle.fetch": "consumer-side shuffle fetch attempt",
     "dataplane.serve": "data-plane request handler",
     "state.save": "scheduler task-status persistence",
+    "state.load": "scheduler state rehydration read at construction",
     "client.rpc": "SchedulerClient RPC, client side",
     "scheduler.progress_report": "executor TaskProgress piggyback "
                                  "assembly (live progress plane)",
@@ -100,6 +107,8 @@ FAULT_POINTS: Dict[str, str] = {
     "scheduler.admission_queue": "admission queue pump (fail = skip "
                                  "this round, the next pump retries; "
                                  "delay = stalled dispatch)",
+    "autoscaler.spawn": "autoscaler scale-up hook, before the spawn "
+                        "(fail = skip this tick, the next retries)",
 }
 
 

@@ -1,5 +1,6 @@
 """Multi-tenant serving benchmark: K concurrent sessions against ONE
-warm LocalCluster (ROADMAP item 4c).
+warm LocalCluster (ROADMAP item 4c), plus the durable-control-plane
+phases (restart recovery, demand-driven autoscaling).
 
 Each session is its own ``BallistaContext`` (own ``session.id``, so the
 admission plane and ``system.sessions`` metering see real tenants)
@@ -11,11 +12,22 @@ the admission gate. Prints ONE JSON line:
      "serving_p50_seconds": ..., "serving_p99_seconds": ...,
      "serving_sheds": ..., "serving_errors": ..., ...}
 
+``--phase restart`` measures scheduler restart recovery over a durable
+sqlite backend: submit a mixed batch (one admitted + planned, the rest
+queued), abandon the service mid-flight, rebuild it on the same file
+and time ``recover()`` — the line carries ``recovery_seconds`` and
+``recovered_jobs``. ``--phase autoscale`` storms a min-sized cluster
+with a 2x session burst under the autoscaler and reports
+``autoscale_events`` and the burst's tail latency
+(``autoscale_p99_seconds``).
+
 ``dev/check_bench_regress.py`` gates serving_qps (higher), the latency
-percentiles (lower) and serving_errors (must stay 0) between rounds.
+percentiles and recovery_seconds (lower), recovered_jobs /
+autoscale_events (nonzero) and the error counts (zero) between rounds.
 
 Usage:
-    python bench_serving.py [--scale 0.05] [--data DIR] [--sessions 4]
+    python bench_serving.py [--phase serving|restart|autoscale]
+                            [--scale 0.05] [--data DIR] [--sessions 4]
                             [--queries-per-session 6] [--executors 2]
                             [--slots 2] [--max-running 4]
                             [--session-quota 2]
@@ -164,8 +176,202 @@ def run_serving(data_dir: str, sessions: int = 4,
         cluster.shutdown()
 
 
+def _tpch_query_params(sql: str, data_dir: str, settings: dict):
+    """ExecuteQueryParams for server-side SQL planning: the raw query
+    plus one catalog descriptor per TPC-H table (what submit_sql ships
+    over the wire, built directly for in-process service calls)."""
+    from ballista_tpu import serde
+    from ballista_tpu.io import TblSource
+    from ballista_tpu.proto import ballista_pb2 as pb
+    from benchmarks.tpch.schema_def import TPCH_PKS, TPCH_SCHEMAS
+
+    params = pb.ExecuteQueryParams()
+    params.sql = sql
+    for k, v in settings.items():
+        params.settings[k] = v
+    for name, sch in TPCH_SCHEMAS.items():
+        path = os.path.join(data_dir, name)
+        if not os.path.exists(path):
+            path = os.path.join(data_dir, f"{name}.tbl")
+        entry = params.catalog.add()
+        entry.name = name
+        entry.source.CopyFrom(
+            serde.source_to_proto(TblSource(path, sch), TPCH_PKS[name]))
+    return params
+
+
+def run_restart(data_dir: str, jobs: int = 6, mix=QUERY_MIX,
+                job_timeout: float = 600.0) -> dict:
+    """The restart phase: submit a mixed batch against a sqlite-backed
+    scheduler (admission.max_running_jobs=1 makes one job admit + plan
+    while the rest queue), abandon the service without any shutdown,
+    rebuild it over the same file and time the recovery pass — the
+    serving gap a real restart would cost."""
+    import shutil
+    import tempfile
+
+    from ballista_tpu.distributed.scheduler import SchedulerService
+    from ballista_tpu.distributed.state import (SchedulerState,
+                                                SqliteBackend)
+
+    qdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "tpch", "queries")
+    sqls = {q: open(os.path.join(qdir, f"{q}.sql")).read() for q in mix}
+    tmp = tempfile.mkdtemp(prefix="ballista-restart-bench-")
+    db = os.path.join(tmp, "state.db")
+    try:
+        svc = SchedulerService(SchedulerState(SqliteBackend(db)))
+        settings = {
+            "session.id": "restart-bench",
+            "admission.max_running_jobs": "1",
+            "admission.queue_timeout_secs": str(job_timeout),
+        }
+        job_ids = []
+        for j in range(jobs):
+            r = svc.ExecuteQuery(_tpch_query_params(
+                sqls[mix[j % len(mix)]], data_dir, settings))
+            job_ids.append(r.job_id)
+        deadline = time.time() + job_timeout
+        while not svc.journal.is_planned(job_ids[0]):
+            if time.time() > deadline:
+                raise RuntimeError("first job never finished planning")
+            time.sleep(0.01)
+        svc.close_health()  # abandon in place: the "crash"
+
+        t0 = time.time()
+        svc2 = SchedulerService(SchedulerState(SqliteBackend(db)))
+        report = svc2.recover()
+        recovery_wall = time.time() - t0  # rehydrate + recovery pass
+        svc2.close_health()
+        return {
+            "metric": "recovered_jobs",
+            "unit": "jobs",
+            "value": report.recovered_jobs,
+            "recovery_seconds": round(recovery_wall, 4),
+            "recovery_pass_seconds": report.recovery_seconds,
+            "recovery_inflight": report.jobs_inflight,
+            "recovery_queued_restored": report.queued_restored,
+            "recovery_relaunched": report.relaunched,
+            "recovery_tasks_requeued": report.tasks_requeued,
+            "recovery_orphans_failed": report.orphans_failed,
+            "recovery_errors": len(report.errors),
+            "restart_jobs_submitted": jobs,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_autoscale(data_dir: str, sessions: int = 4,
+                  queries_per_session: int = 6, executors: int = 2,
+                  slots: int = 2, job_timeout: float = 600.0,
+                  mix=QUERY_MIX) -> dict:
+    """The autoscale phase: a 2x session burst against a MIN-sized
+    fleet with the autoscaler on — it must grow toward the max bound
+    and keep the burst's tail latency finite, then drain back once
+    idle. Decisions land in system.autoscaler."""
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.distributed.controlplane import AutoscalerConfig
+    from ballista_tpu.distributed.executor import LocalCluster
+    from benchmarks.tpch.schema_def import register_tpch
+
+    qdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "tpch", "queries")
+    sqls = {q: open(os.path.join(qdir, f"{q}.sql")).read() for q in mix}
+    burst_sessions = 2 * sessions
+
+    cluster = LocalCluster(num_executors=1, concurrent_tasks=slots)
+    try:
+        svc = cluster.service
+        svc.attach_autoscaler(
+            AutoscalerConfig(enabled=True, min_executors=1,
+                             max_executors=executors, backlog_tasks=2,
+                             cooldown_secs=1.0, idle_secs=2.0,
+                             interval_secs=0.25),
+            spawn_fn=cluster.add_executor,
+            drain_fn=cluster.remove_executor)
+
+        warm_ctx = BallistaContext.remote(
+            "localhost", cluster.port,
+            **{"job.timeout": str(job_timeout),
+               "session.id": "autoscale-warmup"})
+        register_tpch(warm_ctx, data_dir, "tbl")
+        for q in mix:
+            warm_ctx.sql(sqls[q]).collect()
+
+        latencies: list = []
+        errors: list = []
+        lat_lock = threading.Lock()
+        peak_executors = [1]
+        stop = threading.Event()
+
+        def watch_fleet():
+            while not stop.is_set():
+                peak_executors[0] = max(peak_executors[0],
+                                        len(cluster.executors))
+                time.sleep(0.05)
+
+        watcher = threading.Thread(target=watch_fleet, daemon=True)
+        watcher.start()
+
+        def run_session(idx: int):
+            ctx = BallistaContext.remote(
+                "localhost", cluster.port,
+                **{"job.timeout": str(job_timeout),
+                   "session.id": f"autoscale-{idx}"})
+            register_tpch(ctx, data_dir, "tbl")
+            for j in range(queries_per_session):
+                q = mix[(idx + j) % len(mix)]
+                t0 = time.time()
+                try:
+                    ctx.sql(sqls[q]).collect()
+                except Exception as e:  # noqa: BLE001 - recorded
+                    with lat_lock:
+                        errors.append((q, f"{type(e).__name__}: {e}"))
+                else:
+                    with lat_lock:
+                        latencies.append(time.time() - t0)
+
+        threads = [threading.Thread(target=run_session, args=(i,))
+                   for i in range(burst_sessions)]
+        t0 = time.time()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.time() - t0
+        stop.set()
+        watcher.join(1)
+
+        scaler = svc.autoscaler
+        lats = sorted(latencies)
+        return {
+            "metric": "autoscale_qps",
+            "unit": "queries/s",
+            "value": round(len(lats) / wall, 3) if wall > 0 else 0.0,
+            "autoscale_wall_seconds": round(wall, 3),
+            "autoscale_sessions": burst_sessions,
+            "autoscale_completed": len(lats),
+            "autoscale_errors": len(errors),
+            "autoscale_events": (scaler.scale_ups_total
+                                 + scaler.scale_downs_total),
+            "autoscale_ups": scaler.scale_ups_total,
+            "autoscale_downs": scaler.scale_downs_total,
+            "autoscale_peak_executors": peak_executors[0],
+            "autoscale_max_executors": executors,
+            "autoscale_p50_seconds": round(_percentile(lats, 0.50), 4),
+            "autoscale_p99_seconds": round(_percentile(lats, 0.99), 4),
+            "autoscale_error_sample": (str(errors[:3])[:300]
+                                       if errors else ""),
+        }
+    finally:
+        cluster.shutdown()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--phase", choices=("serving", "restart",
+                                        "autoscale"),
+                    default="serving")
     ap.add_argument("--scale", type=float, default=0.05)
     ap.add_argument("--data", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "benchmarks",
@@ -188,12 +394,21 @@ def main() -> int:
         datagen.generate(data_dir, scale=args.scale, num_parts=2)
         open(marker, "w").write("ok\n")
 
-    result = run_serving(
-        data_dir, sessions=args.sessions,
-        queries_per_session=args.queries_per_session,
-        executors=args.executors, slots=args.slots,
-        max_running=args.max_running,
-        session_quota=args.session_quota)
+    if args.phase == "restart":
+        result = run_restart(
+            data_dir, jobs=args.sessions * 2)
+    elif args.phase == "autoscale":
+        result = run_autoscale(
+            data_dir, sessions=args.sessions,
+            queries_per_session=args.queries_per_session,
+            executors=args.executors, slots=args.slots)
+    else:
+        result = run_serving(
+            data_dir, sessions=args.sessions,
+            queries_per_session=args.queries_per_session,
+            executors=args.executors, slots=args.slots,
+            max_running=args.max_running,
+            session_quota=args.session_quota)
     print(json.dumps(result), flush=True)
     return 0
 

@@ -95,6 +95,19 @@ METRICS: Dict[str, Tuple[str, float]] = {
     # engine errors during the storm must stay ZERO (sheds are counted
     # separately — they are policy, not errors)
     "serving_errors": ("zero", 0.0),
+    # PR 17 (durable control plane): bench_serving.py --phase restart
+    # times the rehydrate+recover gap of a scheduler restart over
+    # sqlite; recovered_jobs reads 0 if the journal or the recovery
+    # pass silently dies, and recovery errors are never acceptable.
+    "recovery_seconds": ("lower", 0.50),
+    "recovered_jobs": ("nonzero", 0.0),
+    "recovery_errors": ("zero", 0.0),
+    # --phase autoscale storms a min-sized fleet at 2x sessions: a
+    # burst that triggers no scaling decision means the loop is dead,
+    # and the burst's tail latency must not silently regrow.
+    "autoscale_events": ("nonzero", 0.0),
+    "autoscale_p99_seconds": ("lower", 0.50),
+    "autoscale_errors": ("zero", 0.0),
 }
 
 
@@ -258,6 +271,26 @@ def self_test() -> int:
     rows = {r[0]: r for r in compare({"serving_errors": 3},
                                      {"serving_errors": 0})}
     assert rows["serving_errors"][4] is False
+    # restart phase: recovery_seconds is lower-is-better — a FASTER
+    # recovery must never regress, a 2x slower one must
+    rows = {r[0]: r for r in compare({"recovery_seconds": 2.0},
+                                     {"recovery_seconds": 0.5})}
+    assert rows["recovery_seconds"][4] is False
+    rows = {r[0]: r for r in compare({"recovery_seconds": 1.0},
+                                     {"recovery_seconds": 2.0})}
+    assert rows["recovery_seconds"][4] is True
+    # recovered_jobs / autoscale_events are aliveness gates: only a
+    # drop to 0 regresses (fewer jobs in the batch is configuration)
+    rows = {r[0]: r for r in compare({"recovered_jobs": 6},
+                                     {"recovered_jobs": 0})}
+    assert rows["recovered_jobs"][4] is True
+    rows = {r[0]: r for r in compare({"autoscale_events": 4},
+                                     {"autoscale_events": 1})}
+    assert rows["autoscale_events"][4] is False
+    # recovery/autoscale errors: hard zero
+    rows = {r[0]: r for r in compare({"recovery_errors": 0},
+                                     {"recovery_errors": 1})}
+    assert rows["recovery_errors"][4] is True
     print("self-test ok")
     return 0
 

@@ -324,6 +324,19 @@ def apply_admission(fdp: dp.FileDescriptorProto) -> None:
               F.TYPE_DOUBLE)
 
 
+def apply_controlplane(fdp: dp.FileDescriptorProto) -> None:
+    """PR 17: durable elastic control plane (mirrored by hand in
+    ballista.proto; dev/check_proto_sync.py guards the drift) — the
+    recovered marker on the queued JobStatus (the entry was rebuilt
+    from the journal by a restarted scheduler) and the autoscaler's
+    graceful-drain piggyback on PollWorkResult (the executor stops
+    accepting tasks and exits once its in-flight work completes)."""
+    add_field(get_message(fdp, "QueuedJob"), "recovered", 4,
+              F.TYPE_BOOL)
+    add_field(get_message(fdp, "PollWorkResult"), "drain", 3,
+              F.TYPE_BOOL)
+
+
 TEMPLATE = '''# -*- coding: utf-8 -*-
 # Generated by dev/gen_proto_patch.py (no protoc in this image). DO NOT EDIT!
 # source: ballista.proto
@@ -359,6 +372,7 @@ def main() -> None:
     apply_progress(fdp)
     apply_spill(fdp)
     apply_admission(fdp)
+    apply_controlplane(fdp)
     out = TEMPLATE.format(blob=fdp.SerializeToString())
     with open(PB2, "w") as f:
         f.write(out)
