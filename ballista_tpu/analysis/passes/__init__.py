@@ -11,6 +11,7 @@ from typing import Callable, Dict, List
 
 from ..engine import Rule
 from .cancel_coverage import CancelCoverageRule
+from .h2d_discipline import H2dDisciplineRule
 from .lock_discipline import LockDisciplineRule
 from .shape import (
     DictSitesRule,
@@ -26,6 +27,7 @@ from .sync_span import SyncSpanRule
 RULE_FACTORIES: Dict[str, Callable[[], Rule]] = {
     CancelCoverageRule.id: CancelCoverageRule,
     SyncSpanRule.id: SyncSpanRule,
+    H2dDisciplineRule.id: H2dDisciplineRule,
     LockDisciplineRule.id: LockDisciplineRule,
     JitSitesRule.id: JitSitesRule,
     DictSitesRule.id: DictSitesRule,

@@ -1,0 +1,137 @@
+"""h2d-discipline: scan-side host->device upload sites must route
+through the device-residency layer.
+
+The warm-path table cache (``cache/residency.py``, docs/caching.md)
+pins hot scan outputs device-resident so repeat scans skip parse AND
+the H2D copy entirely. That only works if every table-source upload
+funnels through ONE integration point — ``serve_or_fill`` — with the
+actual uploads living in the produce callback behind it. A scan source
+that uploads directly from ``scan()`` (or never routes through the
+residency layer at all) silently re-pays H2D on every query and its
+bytes are invisible to the device-memory governor: exactly the drift
+this pass prevents after the fact reviews would otherwise catch by
+hand.
+
+Scope: modules under an ``io/`` package directory that implement a
+table source (define a class with a ``scan`` method). Upload sites:
+
+- ``ColumnBatch.from_numpy(...)`` — the engine's canonical batch
+  upload (``jnp.asarray`` inside);
+- ``jnp.asarray(...)`` / ``jnp.array(...)`` — direct device placement
+  (``import jax.numpy as jnp`` provenance, plain numpy is host-only);
+- ``jax.device_put(...)`` / ``device_put(...)`` — the explicit H2D.
+
+A site is covered when its module routes scans through
+``serve_or_fill`` AND the site sits outside the ``scan`` method body
+(i.e. behind the residency layer's produce callback, conventionally
+``_scan_direct``). Anything else is a finding: route the source
+through the residency layer, or suppress with
+``# ballista: ignore[h2d-discipline]`` and a reason (e.g. memtables,
+whose batches are uploaded once at registration and are already
+permanently resident).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from ..engine import Finding, Package, Rule, SourceFile, make_finding
+
+
+def _scan_source_module(tree: ast.AST) -> bool:
+    """True when the module defines a class with a ``scan`` method
+    (a TableSource implementor — shuffle IPC codecs are out of
+    scope)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and item.name == "scan":
+                    return True
+    return False
+
+
+def _routes_through_residency(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = (f.id if isinstance(f, ast.Name)
+                    else f.attr if isinstance(f, ast.Attribute) else "")
+            if name == "serve_or_fill":
+                return True
+    return False
+
+
+def _scan_ranges(tree: ast.AST) -> List[Tuple[int, int]]:
+    """Line ranges of every ``scan`` method body — uploads there run
+    in FRONT of the residency layer, which is the violation."""
+    return [
+        (node.lineno, node.end_lineno or node.lineno)
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name == "scan"
+    ]
+
+
+class H2dDisciplineRule(Rule):
+    id = "h2d-discipline"
+    description = ("scan-side H2D upload sites must route through the "
+                   "device-residency layer (cache/residency.py)")
+
+    def _jax_aliases(self, package: Package, rel: str) -> Set[str]:
+        mi = package.index().module(rel)
+        if mi is None:
+            return set()
+        return {local for local in mi.imports
+                if mi.external_root(local) == "jax"}
+
+    def run(self, package: Package) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in package.files:
+            parts = sf.rel.split("/")
+            if "io" not in parts[:-1]:
+                continue
+            if sf.tree is None or not _scan_source_module(sf.tree):
+                continue
+            jax_aliases = self._jax_aliases(package, sf.rel)
+            routed = _routes_through_residency(sf.tree)
+            scan_spans = _scan_ranges(sf.tree)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = self._upload_kind(node, jax_aliases)
+                if kind is None:
+                    continue
+                in_scan = any(lo <= node.lineno <= hi
+                              for lo, hi in scan_spans)
+                if routed and not in_scan:
+                    continue
+                why = ("inside scan() in front of the residency layer"
+                       if routed else
+                       "in a module that never routes through "
+                       "serve_or_fill")
+                findings.append(make_finding(
+                    self.id, sf, node.lineno,
+                    f"{kind} {why} (move uploads behind "
+                    "cache.residency.serve_or_fill's produce callback "
+                    "or suppress with a reason)"))
+        return findings
+
+    @staticmethod
+    def _upload_kind(call: ast.Call,
+                     jax_aliases: Set[str]) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "from_numpy":
+                return "ColumnBatch.from_numpy upload"
+            base = f.value
+            if isinstance(base, ast.Name) and base.id in jax_aliases:
+                if f.attr in ("asarray", "array"):
+                    return f"jnp.{f.attr} upload"
+                if f.attr == "device_put":
+                    return "jax.device_put upload"
+        elif isinstance(f, ast.Name) and f.id == "device_put":
+            return "device_put upload"
+        return None

@@ -486,6 +486,23 @@ class BallistaContext:
         from .physical.fusion import maybe_fuse
 
         phys = maybe_fuse(phys)
+        # plan-fingerprint result cache (cache/results.py, opt-in): a
+        # repeat of the same fused plan over unchanged files with the
+        # same settings returns the stored pydict without executing.
+        # Keyed AFTER fusion so the fingerprint covers the real
+        # programs; EXPLAIN trees execute nothing worth caching and
+        # ANALYZE must re-measure, so both bypass.
+        from .cache import results as _results
+        from .physical.explain import ExplainAnalyzeExec, ExplainExec
+
+        rc_key = None
+        if (_results.result_cache_enabled(self.settings)
+                and not isinstance(phys, (ExplainAnalyzeExec, ExplainExec))):
+            rc_key = _results.plan_key(phys, self.settings)
+            cached = _results.process_result_cache().lookup(rc_key)
+            if cached is not None:
+                self._annotate_cache_hits(result_hit=True)
+                return pd.DataFrame(cached), phys
         if metrics_enabled():
             # cached plans re-execute: last_query_metrics() must report
             # THIS query, not the lifetime accumulation — and the reset
@@ -525,11 +542,38 @@ class BallistaContext:
             from .observability import progress as obs_progress
 
             obs_progress.attach_current_plan(phys)
-            out = pd.DataFrame(collect_physical(phys))
+            data = collect_physical(phys)
+            out = pd.DataFrame(data)
         finally:
             cancel_plan(phys)
         self._record_plan_metrics(phys)
+        if rc_key is not None:
+            _results.process_result_cache().fill(rc_key, data)
+        self._annotate_cache_hits(phys)
         return out, phys
+
+    def _annotate_cache_hits(self, phys=None, result_hit=False) -> None:
+        """Per-session warm-path attribution (system.sessions): sum the
+        plan's ScanExec table_cache_hits counters for THIS collect
+        (reset_plan_metrics zeroed them at entry) and/or flag a
+        result-cache hit. Never bumps the meter's query count."""
+        from .observability.progress import process_session_meter
+
+        hits = 0
+        if phys is not None:
+            def walk(node):
+                nonlocal hits
+                m = getattr(node, "_metrics", None)
+                if m is not None:
+                    hits += int(m._counters.get("table_cache_hits", 0) or 0)
+                for c in node.children():
+                    walk(c)
+
+            walk(phys)
+        if hits or result_hit:
+            process_session_meter().annotate_cache(
+                self.settings.get("session.id"), hits,
+                1 if result_hit else 0)
 
     def _apply_adaptive(self, phys):
         """Standalone adaptive execution: rewrite the planned tree from

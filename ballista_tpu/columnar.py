@@ -375,7 +375,11 @@ class ColumnBatch:
     constructors; operators that filter must update both).
     """
 
-    __slots__ = ("schema", "columns", "selection", "num_rows")
+    # _transient: donation eligibility (cache/donation.py) — True only
+    # when the CREATOR guarantees single consumption; never flattened
+    # into the pytree, consumed at most once by a donating call site
+    __slots__ = ("schema", "columns", "selection", "num_rows",
+                 "_transient")
 
     def __init__(
         self,
@@ -388,6 +392,7 @@ class ColumnBatch:
         self.columns: Tuple[Column, ...] = tuple(columns)
         self.selection = selection
         self.num_rows = num_rows
+        self._transient = False
         if len(self.columns) != len(schema):
             raise SchemaError(
                 f"schema has {len(schema)} fields but {len(self.columns)} columns given"

@@ -448,6 +448,27 @@ class AdmissionController:
         with self._lock:
             return len(self._queue)
 
+    def admittable_queue_depth(self) -> int:
+        """Queued entries that added cluster capacity could actually
+        admit. A job queued behind its OWN session's concurrency quota
+        (``admission.max_session_jobs``) stays queued no matter how
+        many executors join — counting it would make the autoscaler
+        buy machines a single tenant's quota forbids it from using.
+        Walked in pop order with virtual slots: once a session's
+        running + admittable-queued jobs reach its quota, the rest of
+        that session's backlog is invisible to scaling."""
+        with self._lock:
+            virtual = dict(self._session_jobs)
+            n = 0
+            for d in self._queue:
+                cfg = d.config
+                cap = cfg.max_session_jobs if cfg is not None else 0
+                if cap and virtual.get(d.session_id, 0) >= cap:
+                    continue
+                virtual[d.session_id] = virtual.get(d.session_id, 0) + 1
+                n += 1
+            return n
+
     def queue_info(self, job_id: str) -> Optional[dict]:
         """Queue position (1-based, in pop order) + reason + wait so
         far, or None when the job is not admission-queued."""

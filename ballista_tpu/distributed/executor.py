@@ -252,6 +252,15 @@ class Executor:
                 log.warning("final status flush failed", exc_info=True)
         self._data_plane.close()
         self._pool.shutdown(wait=False)
+        # release device-resident table-cache pins: a stopped executor
+        # must not keep device memory pinned while the process lingers
+        # (embedding tests / LocalCluster reuse the same process)
+        try:
+            from ..cache.residency import process_table_cache
+
+            process_table_cache().invalidate()
+        except Exception:  # noqa: BLE001 - best-effort on shutdown
+            pass
         if self._health is not None:
             self._health.close()
 

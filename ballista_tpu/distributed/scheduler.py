@@ -501,8 +501,11 @@ class SchedulerService:
                 res = getattr(m, "resources", None) or {}
                 inflight += int(res.get("inflight_tasks") or 0)
             return {
+                # admission backlog counts only ADMITTABLE queued jobs:
+                # work held by its own session quota must not trigger
+                # scale-up (admission.admittable_queue_depth)
                 "backlog": self.state.ready_queue_depth()
-                + self.admission.queue_depth(),
+                + self.admission.admittable_queue_depth(),
                 "inflight": inflight,
                 "executors": len(metas),
                 "eta_seconds": eta,

@@ -147,6 +147,27 @@ def collect_physical(phys: PhysicalPlan) -> Dict[str, np.ndarray]:
     return concat_pydicts(parts)
 
 
+def collect_physical_cached(phys: PhysicalPlan,
+                            settings=None) -> Dict[str, np.ndarray]:
+    """:func:`collect_physical` behind the plan-fingerprint result
+    cache (cache/results.py). The library-level surface for callers
+    without a BallistaContext (the client collect path hooks the cache
+    itself, earlier, to also skip prewarm/priming on a hit). Plans with
+    unsignable leaves execute normally every time."""
+    from .cache import results as _results
+
+    if not _results.result_cache_enabled(settings):
+        return collect_physical(phys)
+    key = _results.plan_key(phys, settings)
+    cache = _results.process_result_cache()
+    data = cache.lookup(key)
+    if data is not None:
+        return data
+    data = collect_physical(phys)
+    cache.fill(key, data)
+    return data
+
+
 def collect(plan: LogicalPlan, options=None):
     """Logical plan -> pandas DataFrame (optimize, plan, execute, gather)."""
     import pandas as pd

@@ -52,6 +52,12 @@ class CacheSource(TableSource):
     def source_descriptor(self) -> dict:
         return self.inner.source_descriptor()
 
+    def content_signature(self):
+        """Result-cache identity is the INNER data's identity — this
+        wrapper adds replay, not different rows."""
+        sig_fn = getattr(self.inner, "content_signature", None)
+        return sig_fn() if sig_fn is not None else None
+
     def estimated_rows(self):
         return self.inner.estimated_rows()
 
@@ -69,6 +75,11 @@ class CacheSource(TableSource):
             with self._key_locks.get(key):
                 if key not in self._cache:
                     batches = list(self.inner.scan(partition, projection))
+                    # replayed every query: a transient mark from the
+                    # inner scan would let the first consumer donate
+                    # (delete) buffers later replays still serve
+                    for b in batches:
+                        b._transient = False
                     from ..observability import memory as obs_memory
 
                     n = self._batches_nbytes(batches)

@@ -154,7 +154,33 @@ class ParquetSource(TableSource):
                 self._dicts[colname] = d
                 return d
 
+    def content_signature(self) -> Optional[tuple]:
+        """Re-stat'd file identity — the result-cache invalidation
+        signal for parquet tables."""
+        from .. import columnar_registry
+
+        return columnar_registry.file_entry_key(
+            "parquet", self._path, self._files)
+
+    def residency_key(self, partition: int,
+                      projection=None) -> Optional[tuple]:
+        from ..cache import residency
+
+        return residency.scan_key(
+            "parquet", self._files[partition], partition, projection,
+            extra=(self._capacity,))
+
     def scan(self, partition: int, projection: Optional[Sequence[str]] = None):
+        from ..cache import residency
+
+        yield from residency.serve_or_fill(
+            self.residency_key(partition, projection),
+            lambda: self._scan_direct(partition, projection),
+            outcome_sink=self._note_scan_outcome(partition))
+
+    def _scan_direct(self, partition: int,
+                     projection: Optional[Sequence[str]] = None):
+        """The uncached parse + H2D path (residency misses land here)."""
         import pyarrow.parquet as pq
 
         names = list(projection) if projection is not None else list(self._schema.names())

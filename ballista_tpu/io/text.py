@@ -257,12 +257,50 @@ class DelimitedSource(TableSource):
                 and all(f.dtype.kind in native._KIND_CODES
                         for f in self._schema.fields))
 
+    def content_signature(self) -> Optional[tuple]:
+        """Re-stat'd file identity + the format knobs that change parsed
+        rows — the result-cache invalidation signal for text tables."""
+        from .. import columnar_registry
+
+        return columnar_registry.file_entry_key(
+            "text", self._path, self._files
+        ) + (self._delim, self._header, self._trailing)
+
+    def residency_key(self, partition: int,
+                      projection=None) -> Optional[tuple]:
+        from ..cache import residency
+
+        # large files stream in byte-range chunks (bounded RAM at any
+        # scale): their output would evict the whole device cache for
+        # one table, so they bypass residency (key=None -> plain
+        # streaming with transient batches)
+        try:
+            size = os.path.getsize(self._files[partition])
+        except OSError:
+            size = 0
+        if self._use_native() and size > STREAM_CHUNK_BYTES:
+            return None
+        return residency.scan_key(
+            "tbl" if self._delim == "|" else "csv",
+            self._files[partition], partition, projection,
+            extra=(self._delim, self._header, self._trailing,
+                   self._capacity),
+        )
+
     def scan(self, partition: int, projection: Optional[Sequence[str]] = None):
+        from ..cache import residency
+
+        yield from residency.serve_or_fill(
+            self.residency_key(partition, projection),
+            lambda: self._scan_direct(partition, projection),
+            outcome_sink=self._note_scan_outcome(partition))
+
+    def _scan_direct(self, partition: int,
+                     projection: Optional[Sequence[str]] = None):
+        """The uncached parse + H2D path (residency misses land here)."""
         names = projection if projection is not None else self._schema.names()
         sub_schema = self._schema.project(names)
         if self._use_native():
-            # large files stream in byte-range chunks (bounded RAM at any
-            # scale); small files keep the single-parse fast path
             size = os.path.getsize(self._files[partition])
             if size > STREAM_CHUNK_BYTES:
                 yield from self._scan_native_streaming(

@@ -71,6 +71,49 @@ class TableSource:
         """Cheap row-count estimate (file sizes / metadata); None=unknown."""
         return None
 
+    def content_signature(self) -> Optional[tuple]:
+        """Identity of the data this source serves, re-stat'd at call
+        time (file sizes + mtimes). The result-cache key ingredient:
+        None (the default) marks the source unsignable, making any plan
+        over it uncacheable — memtables and system tables stay live."""
+        return None
+
+    def residency_key(self, partition: int,
+                      projection=None) -> Optional[tuple]:
+        """Device-residency cache key for one partition scan; None =
+        this source never routes through the residency layer."""
+        return None
+
+    def is_resident(self, partition: int, projection=None) -> bool:
+        """Whether this partition's scan output is device-resident
+        right now (prefetch routing: no parse/H2D left to overlap)."""
+        key = self.residency_key(partition, projection)
+        if key is None:
+            return False
+        from .cache.residency import process_table_cache
+
+        return process_table_cache().contains(key)
+
+    def scan_cache_outcome(self, partition: int) -> Optional[str]:
+        """Device-residency outcome of this partition's most recent
+        scan (``hit``/``filled``/``miss``), for EXPLAIN ANALYZE; None
+        when the source doesn't route through the residency layer."""
+        outcomes = getattr(self, "_scan_outcomes", None)
+        return outcomes.get(partition) if outcomes else None
+
+    def _note_scan_outcome(self, partition: int):
+        """Sink for ``cache.residency.serve_or_fill``: records the
+        outcome per partition (benign last-writer-wins race, display
+        only)."""
+
+        def sink(outcome: str) -> None:
+            outcomes = getattr(self, "_scan_outcomes", None)
+            if outcomes is None:
+                outcomes = self._scan_outcomes = {}
+            outcomes[partition] = outcome
+
+        return sink
+
 
 @dataclass
 class TableScan(LogicalPlan):

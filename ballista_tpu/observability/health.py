@@ -211,6 +211,26 @@ def base_process_samples() -> List[Sample]:
     ]
     for cat, n in sorted(snap["by_category"].items()):
         out.append(("ballista_host_category_bytes", {"category": cat}, n))
+    from ..cache import cache_counters
+
+    cc = cache_counters()
+    out.extend([
+        ("ballista_cache_table_hits_total", {}, cc["table_cache_hits"]),
+        ("ballista_cache_table_misses_total", {},
+         cc["table_cache_misses"]),
+        ("ballista_cache_table_fills_total", {}, cc["table_cache_fills"]),
+        ("ballista_cache_table_evictions_total", {},
+         cc["table_cache_evictions"]),
+        ("ballista_cache_table_resident_bytes", {},
+         cc["table_cache_resident_bytes"]),
+        ("ballista_cache_result_hits_total", {}, cc["result_cache_hits"]),
+        ("ballista_cache_result_misses_total", {},
+         cc["result_cache_misses"]),
+        ("ballista_cache_result_bytes", {}, cc["result_cache_bytes"]),
+        ("ballista_cache_donated_buffers_total", {},
+         cc["donated_buffers"]),
+        ("ballista_cache_donated_bytes_total", {}, cc["donated_bytes"]),
+    ])
     return out
 
 

@@ -721,7 +721,9 @@ class SessionMeter:
                        "wall_seconds": 0.0, "task_seconds": 0.0,
                        "device_blocked_seconds": 0.0,
                        "bytes_shuffled": 0, "peak_host_bytes": 0,
-                       "peak_device_bytes": 0, "started_at": now}
+                       "peak_device_bytes": 0,
+                       "table_cache_hits": 0, "result_cache_hits": 0,
+                       "started_at": now}
             rec["queries"] += 1
             rec["wall_seconds"] = round(
                 rec["wall_seconds"] + float(wall_seconds), 4)
@@ -755,6 +757,38 @@ class SessionMeter:
             rec["device_blocked_seconds"] = round(
                 rec["device_blocked_seconds"]
                 + float(device_blocked_seconds), 4)
+            rec["last_active"] = time.time()
+            self._maybe_save_locked()
+
+    def annotate_cache(self, session_id: str, table_cache_hits: int = 0,
+                       result_cache_hits: int = 0) -> None:
+        """Warm-path cache attribution for an already-recorded query —
+        like :meth:`annotate`, this never bumps the ``queries`` count
+        (a result-cache hit IS a query; its hit flag arrives
+        separately)."""
+        if not table_cache_hits and not result_cache_hits:
+            return
+        sid = str(session_id or "anonymous")
+        with self._lock:
+            rec = self._sessions.get(sid)
+            if rec is None:
+                # annotation can land before the recorder's terminal
+                # record() (first query of a session): seed a zero-query
+                # record, record() accumulates into it
+                rec = self._sessions[sid] = {
+                    "session_id": sid, "queries": 0,
+                    "wall_seconds": 0.0, "task_seconds": 0.0,
+                    "device_blocked_seconds": 0.0,
+                    "bytes_shuffled": 0, "peak_host_bytes": 0,
+                    "peak_device_bytes": 0,
+                    "table_cache_hits": 0, "result_cache_hits": 0,
+                    "started_at": time.time()}
+            # pre-cache records loaded from disk lack the fields
+            rec["table_cache_hits"] = (
+                int(rec.get("table_cache_hits", 0)) + int(table_cache_hits))
+            rec["result_cache_hits"] = (
+                int(rec.get("result_cache_hits", 0))
+                + int(result_cache_hits))
             rec["last_active"] = time.time()
             self._maybe_save_locked()
 

@@ -67,6 +67,9 @@ OPERATOR_METRICS = {
     "bytes_written": ("counter", "partition/shuffle output bytes"),
     "elapsed_write": ("timer", "partition IPC write time"),
     "selectivity": ("gauge", "filter pass fraction"),
+    "table_cache_hits": ("counter", "partition scans served from the "
+                                    "device-resident table cache "
+                                    "(parse + H2D skipped)"),
 }
 
 # -- Prometheus families exported by the health plane ------------------------
@@ -163,6 +166,37 @@ PROCESS_METRICS = {
                                               "the admission queue "
                                               "(label outcome=admitted|"
                                               "shed)"),
+    # warm-path cache tiers (ballista_tpu/cache/)
+    "ballista_cache_table_hits_total": ("counter", "partition scans served "
+                                                   "from the device-"
+                                                   "resident table cache"),
+    "ballista_cache_table_misses_total": ("counter", "partition scans that "
+                                                     "found no resident "
+                                                     "entry"),
+    "ballista_cache_table_fills_total": ("counter", "partitions pinned "
+                                                    "into the table "
+                                                    "cache"),
+    "ballista_cache_table_evictions_total": ("counter", "pinned partitions "
+                                                        "evicted for "
+                                                        "budget"),
+    "ballista_cache_table_resident_bytes": ("gauge", "device bytes pinned "
+                                                     "by the table cache "
+                                                     "governor"),
+    "ballista_cache_result_hits_total": ("counter", "collects served from "
+                                                    "the plan-fingerprint "
+                                                    "result cache"),
+    "ballista_cache_result_misses_total": ("counter", "result-cache "
+                                                      "lookups that "
+                                                      "executed"),
+    "ballista_cache_result_bytes": ("gauge", "host bytes held by cached "
+                                             "result sets"),
+    "ballista_cache_donated_buffers_total": ("counter", "governed calls "
+                                                        "that donated a "
+                                                        "transient batch's "
+                                                        "device buffers"),
+    "ballista_cache_donated_bytes_total": ("counter", "device bytes "
+                                                      "donated through "
+                                                      "fused stages"),
     # autoscaler (scheduler; distributed/controlplane/autoscaler.py)
     "ballista_autoscale_target_executors": ("gauge", "fleet size the "
                                                      "autoscaler is "

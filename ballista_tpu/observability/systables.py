@@ -16,6 +16,8 @@ own telemetry becomes relational tables served by the engine itself:
   queries, long format (one row per operator x metric).
 - ``system.compile``   — compile-governor entries: signature, calls,
   compiles, elapsed compile seconds, persistent-cache hits, AOT loads.
+- ``system.cache``     — warm-path serving caches (docs/caching.md):
+  one row per device-resident table entry / host result-cache entry.
 - ``system.executors`` — executor heartbeat resources (cluster) or one
   row for the current process (standalone).
 - ``system.settings``  — every ``BALLISTA_*`` knob: effective value,
@@ -218,6 +220,24 @@ KNOBS: Dict[str, tuple] = {
         "67108864", "cost feedback sizes shuffle partition counts so "
                     "each partition carries about this many observed "
                     "shuffle bytes"),
+    # warm-path serving caches (docs/caching.md)
+    "BALLISTA_TABLE_CACHE": ("on", "pin hot scan outputs device-resident "
+                                   "across queries (parse + H2D skipped "
+                                   "on repeat scans)"),
+    "BALLISTA_TABLE_CACHE_BUDGET_MB": ("512", "device-memory budget for "
+                                              "pinned table batches"),
+    "BALLISTA_TABLE_CACHE_WATERMARK": ("0.9", "budget fraction past which "
+                                              "fills evict coldest "
+                                              "entries (never block)"),
+    "BALLISTA_RESULT_CACHE": ("off", "plan-fingerprint result cache: "
+                                     "repeat collects of an identical "
+                                     "plan over unchanged inputs return "
+                                     "host-cached rows"),
+    "BALLISTA_RESULT_CACHE_BUDGET_MB": ("64", "host-memory budget for "
+                                              "cached query results"),
+    "BALLISTA_DONATION": ("on", "donate single-consumer intermediate "
+                                "buffers into governed programs "
+                                "(donate_argnums in-place reuse)"),
 }
 
 # dynamic env-name families: read via computed names, documented as
@@ -334,7 +354,18 @@ SYSTEM_SCHEMAS: Dict[str, Schema] = {
         ("wall_seconds", Float64), ("task_seconds", Float64),
         ("device_blocked_seconds", Float64), ("bytes_shuffled", Int64),
         ("peak_host_bytes", Int64), ("peak_device_bytes", Int64),
+        # warm-path cache attribution (docs/caching.md): scans served
+        # from the device table cache / collects served from the
+        # result cache, accumulated per session
+        ("table_cache_hits", Int64), ("result_cache_hits", Int64),
         ("started_at", Float64), ("last_active", Float64),
+    ),
+    # warm-path serving caches (cache/residency.py + cache/results.py):
+    # one row per live entry across both tiers
+    "system.cache": make_schema(
+        ("tier", Utf8), ("entry", Utf8), ("bytes", Int64),
+        ("hits", Int64), ("age_seconds", Float64),
+        ("idle_seconds", Float64),
     ),
     # admission plane (distributed/admission.py): recent gate/pump
     # decisions — the scheduler's ring on the cluster path, empty
@@ -922,7 +953,21 @@ def _local_stages_rows() -> List[dict]:
 def _session_rows() -> List[dict]:
     from . import progress as obs_progress
 
-    return obs_progress.process_session_meter().rows()
+    rows = obs_progress.process_session_meter().rows()
+    # records persisted by older builds predate the cache-attribution
+    # columns; surface them as 0, not NULL
+    for r in rows:
+        r.setdefault("table_cache_hits", 0)
+        r.setdefault("result_cache_hits", 0)
+    return rows
+
+
+def _cache_rows() -> List[dict]:
+    from ..cache.residency import process_table_cache
+    from ..cache.results import process_result_cache
+
+    return (process_table_cache().entry_rows()
+            + process_result_cache().entry_rows())
 
 
 class SystemSnapshot:
@@ -964,6 +1009,8 @@ class SystemSnapshot:
             return (self._operators or _OPERATOR_STORE).rows()
         if table == "system.compile":
             return _compile_rows()
+        if table == "system.cache":
+            return _cache_rows()
         if table == "system.executors":
             return self._executors_fn()
         if table == "system.tasks":

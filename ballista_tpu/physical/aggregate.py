@@ -202,14 +202,19 @@ class HashAggregateExec(PhysicalPlan):
         return batch
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        from ..cache.donation import mark_transient
+
         batches = list(self.child.execute(partition))
         if not batches:
             return
         batch = concat_batches(self._in_schema, batches)
         if not self.group_exprs:
-            yield self._exec_scalar(batch)
-            return
-        yield self._exec_grouped(batch)
+            out = self._exec_scalar(batch)
+        else:
+            out = self._exec_grouped(batch)
+        # fresh program output, one downstream consumer: donatable
+        mark_transient(out)
+        yield out
 
     # grouped ---------------------------------------------------------------
 
@@ -411,7 +416,9 @@ class HashAggregateExec(PhysicalPlan):
         cap = self.group_capacity
         bound = self._static_group_bound(batch)
         if bound is not None and bound <= min(DENSE_GROUP_LIMIT, cap):
-            out, _ng = self._get_grouped_fn(cap, batch.capacity)(batch)
+            # one call, no overflow retry: safe to donate the batch
+            out, _ng = self.governed_call(("agg.grouped", cap),
+                                          self._grouped_build(cap), batch)
             return out  # dense path, can't overflow: no sync needed
         # rejected once (hash-like sparse ids / huge products) -> rejected
         # for the operator's lifetime: don't pay the stats round-trip again
@@ -449,11 +456,16 @@ class HashAggregateExec(PhysicalPlan):
                 # has to fit the absolute limit.
                 if (true_total <= self._RANGED_CAP_FACTOR * (nlive + 256)
                         and g_total <= self._RANGED_DENSE_LIMIT):
-                    fn = self._get_mixed_fn(tuple(spans), batch.capacity,
-                                            layout)
-                    out, _ng = fn(batch, jnp.asarray(bases, jnp.int64))
+                    # final call on this batch (_mixed_stats's read has
+                    # fully completed — device_get blocks): donatable
+                    out, _ng = self.governed_call(
+                        ("agg.mixed", tuple(spans), tuple(layout)),
+                        self._mixed_build(tuple(spans), layout),
+                        batch, jnp.asarray(bases, jnp.int64))
                     return out  # gid < G by construction: no overflow sync
                 self._ranged_rejected = True
+        # overflow-retry loop re-reads the SAME batch after an
+        # undersized attempt — never donate here
         while True:
             fn = self._get_grouped_fn(cap, batch.capacity)
             out, num_groups = fn(batch)
@@ -506,7 +518,7 @@ class HashAggregateExec(PhysicalPlan):
             jnp.minimum(res.num_groups, cap),
         )
 
-    def _get_grouped_fn(self, cap: int, in_cap: int):
+    def _grouped_build(self, cap: int):
         def build():
             tw = self.trace_twin()  # don't pin the input subtree
 
@@ -519,9 +531,13 @@ class HashAggregateExec(PhysicalPlan):
 
             return run
 
+        return build
+
+    def _get_grouped_fn(self, cap: int, in_cap: int):
         # in_cap rides the traced batch shape; only the static group
         # capacity needs to be in the key
-        return self.governed_jit(("agg.grouped", cap), build)
+        return self.governed_jit(("agg.grouped", cap),
+                                 self._grouped_build(cap))
 
     def _get_mixed_fn(self, spans, in_cap: int, layout):
         """Grouping program for mixed dict/ranged-int keys: mixed-radix
@@ -530,6 +546,10 @@ class HashAggregateExec(PhysicalPlan):
         are a traced argument so consecutive batches with different
         ranges but the same quantized spans reuse one compiled
         program."""
+        return self.governed_jit(("agg.mixed", spans, tuple(layout)),
+                                 self._mixed_build(spans, layout))
+
+    def _mixed_build(self, spans, layout):
         def build():
             tw = self.trace_twin()
             g_total = 1
@@ -562,7 +582,7 @@ class HashAggregateExec(PhysicalPlan):
 
             return run
 
-        return self.governed_jit(("agg.mixed", spans, tuple(layout)), build)
+        return build
 
     def _finalize(self, res) -> List[Column]:
         """final mode: merge states -> output aggregate columns."""
@@ -598,7 +618,7 @@ class HashAggregateExec(PhysicalPlan):
 
     # ungrouped -------------------------------------------------------------
 
-    def _get_scalar_fn(self):
+    def _scalar_build(self):
         def build():
             tw = self.trace_twin()
 
@@ -612,10 +632,15 @@ class HashAggregateExec(PhysicalPlan):
 
             return run
 
-        return self.governed_jit(("agg.scalar",), build)
+        return build
+
+    def _get_scalar_fn(self):
+        return self.governed_jit(("agg.scalar",), self._scalar_build())
 
     def _exec_scalar(self, batch: ColumnBatch) -> ColumnBatch:
-        vals, valids = self._get_scalar_fn()(batch)
+        # single call, batch never touched again: donate when transient
+        vals, valids = self.governed_call(("agg.scalar",),
+                                          self._scalar_build(), batch)
 
         cap = 8
         sel = np.zeros(cap, dtype=bool)

@@ -35,6 +35,33 @@ class Partitioning:
     hash_columns: tuple = ()
 
 
+# Split-call donation convention (cache/donation.py): the batch treedef
+# is static (arg 0), the column/validity/selection leaves are the
+# donated payload (arg 1), num_rows rides as a plain argument (arg 2) —
+# never donated, see PhysicalPlan.governed_call.
+DONATING_JIT_KWARGS = {"static_argnums": (0,), "donate_argnums": (1,)}
+
+
+def _donating_build(build):
+    """Wrap a ``build()`` producing ``run(batch, *extra)`` into one
+    producing the split-call form ``run(treedef, payload, num_rows,
+    *extra)`` that reconstructs the batch inside the trace. num_rows is
+    the LAST flattened leaf (columnar._flatten_batch), so unflatten
+    appends it to the payload."""
+
+    def build_donating():
+        run = build()
+
+        def run_split(treedef, payload, num_rows, *extra):
+            batch = jax.tree_util.tree_unflatten(
+                treedef, list(payload) + [num_rows])
+            return run(batch, *extra)
+
+        return run_split
+
+    return build_donating
+
+
 class PhysicalPlan:
     """Base physical operator.
 
@@ -97,6 +124,35 @@ class PhysicalPlan:
         kw.setdefault("aot", True)
         return governed(key, build, metrics=metrics, **kw)
 
+    def governed_call(self, subkey: tuple, build, batch: ColumnBatch,
+                      *extra):
+        """Run the governed program under ``subkey`` on ``batch``,
+        donating the batch's device buffers when it is transient
+        (single-consumer intermediate, cache/donation.py) and donation
+        is enabled. The donating variant is a SEPARATE governed entry
+        (``<namespace>.don``) because ``donate_argnums`` is
+        incompatible with AOT attachment, and because its call
+        convention splits the batch: the treedef rides as a static
+        argument, column/validity/selection leaves are the donated
+        payload, and ``num_rows`` stays an ordinary argument —
+        MetricsSet.record_output_batch holds that scalar in
+        ``_pending_rows`` long after the batch body is consumed, so
+        donating it would hand ``_resolve_rows`` deleted buffers."""
+        from ..cache.donation import (consume_transient, donation_enabled,
+                                      record_donation)
+
+        if donation_enabled() and consume_transient(batch):
+            fn = self.governed_jit(
+                (subkey[0] + ".don",) + tuple(subkey[1:]),
+                _donating_build(build),
+                jit_kwargs=dict(DONATING_JIT_KWARGS), aot=False)
+            leaves, treedef = jax.tree_util.tree_flatten(batch)
+            payload, num_rows = tuple(leaves[:-1]), leaves[-1]
+            record_donation(sum(int(getattr(x, "nbytes", 0))
+                                for x in payload))
+            return fn(treedef, payload, num_rows, *extra)
+        return self.governed_jit(subkey, build)(batch, *extra)
+
     def trace_twin(self) -> "PhysicalPlan":
         """Config-only shallow clone for governed closures to capture.
 
@@ -128,6 +184,8 @@ class PhysicalPlan:
             self.child = SchemaLeaf(self.child.output_schema())
         if getattr(self, "_fused_fn", None) is not None:
             self._fused_fn = None  # no entry->twin->entry cycles
+        if getattr(self, "_fused_don_fn", None) is not None:
+            self._fused_don_fn = None
 
     def output_schema(self) -> Schema:
         raise NotImplementedError
@@ -258,7 +316,38 @@ class PipelineOp(PhysicalPlan):
                                               aot=True)
         return fused
 
+    def _fused_governed_donating(self):
+        """Donating twin of :meth:`_fused_governed` (split-call
+        convention, see ``governed_call``): used per-batch when the
+        incoming batch is transient. Shares the chain-signature key
+        shape under the ``pipeline.fused.don`` namespace; not
+        AOT-eligible (donate_argnums)."""
+        fused = getattr(self, "_fused_don_fn", None)
+        if fused is None:
+            chain, _ = self._pipeline_chain()
+
+            def build():
+                twins = [op.trace_twin() for op in chain]
+
+                def apply_all(batch):
+                    for op in twins:
+                        batch = op.device_transform(batch)
+                    return batch
+
+                return apply_all
+
+            key = ("pipeline.fused.don",
+                   tuple(op.compile_signature() for op in chain))
+            metrics = self.metrics() if metrics_enabled() else None
+            fused = self._fused_don_fn = governed(
+                key, _donating_build(build), metrics=metrics,
+                jit_kwargs=dict(DONATING_JIT_KWARGS))
+        return fused
+
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        from ..cache.donation import (consume_transient, donation_enabled,
+                                      mark_transient, record_donation)
+
         chain, source = self._pipeline_chain()
         fused = self._fused_governed()
         # Adaptive: a filter's selectivity is stationary within a query,
@@ -281,7 +370,18 @@ class PipelineOp(PhysicalPlan):
             # the governor records the compile-vs-execute split: a call
             # that triggers an XLA compile lands its duration on this
             # operator's elapsed_compile / compile_count metrics
-            out = fused(batch)
+            if donation_enabled() and consume_transient(batch):
+                # single-consumer scan/concat output: hand XLA the
+                # buffers so the fused program writes in place instead
+                # of allocating a second copy of the batch
+                leaves, treedef = jax.tree_util.tree_flatten(batch)
+                payload, num_rows = tuple(leaves[:-1]), leaves[-1]
+                record_donation(sum(int(getattr(x, "nbytes", 0))
+                                    for x in payload))
+                out = self._fused_governed_donating()(
+                    treedef, payload, num_rows)
+            else:
+                out = fused(batch)
             if compact and getattr(self, "_compact_misses", 0) < 2:
                 res = maybe_compact(
                     out, floor=getattr(self, "_compact_floor", 8))
@@ -294,6 +394,9 @@ class PipelineOp(PhysicalPlan):
                         getattr(self, "_compact_floor", 8), res.capacity)
                     self.metrics().add_counter("compact_count")
                 out = res
+            # fresh XLA output (or fresh compaction), exactly one
+            # downstream consumer: donation-eligible
+            mark_transient(out)
             yield out
 
 
@@ -367,7 +470,15 @@ def concat_batches(schema: Schema, batches: List[ColumnBatch]) -> ColumnBatch:
         cols.append(Column(vals, f.dtype, validity, dict_))
     selection = jnp.concatenate([b.selection for b in batches])
     num_rows = sum([b.num_rows for b in batches])
-    return ColumnBatch(schema, cols, selection, num_rows)
+    out = ColumnBatch(schema, cols, selection, num_rows)
+    # fresh jnp.concatenate buffers with exactly one consumer (the
+    # aggregation/sort program the concat feeds): donation-eligible.
+    # The len == 1 pass-through above deliberately inherits the input's
+    # own transiency instead — pinned cache batches stay pinned.
+    from ..cache.donation import mark_transient
+
+    mark_transient(out)
+    return out
 
 
 # Measured cost of a blocking scalar device->host read (seconds). When the

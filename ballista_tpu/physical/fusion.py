@@ -196,14 +196,19 @@ class FusedStageExec(HashAggregateExec):
         return _chain_prologue(self.chain, batch)
 
     def execute(self, partition: int) -> Iterator[ColumnBatch]:
+        from ..cache.donation import mark_transient
+
         batches = list(self.source.execute(partition))
         if not batches:
             return
         batch = concat_batches(self.source.output_schema(), batches)
         if not self.group_exprs:
-            yield self._exec_scalar(batch)
-            return
-        yield self._exec_grouped(batch)
+            out = self._exec_scalar(batch)
+        else:
+            out = self._exec_grouped(batch)
+        # fresh program output, one downstream consumer: donatable
+        mark_transient(out)
+        yield out
 
     def _post_chain_abstract(self, batch: ColumnBatch):
         """Abstract (eval_shape) post-chain batch for host-side path

@@ -96,8 +96,9 @@ class ScanExec(PhysicalPlan):
 
     def _prefetchable(self, partition: int) -> bool:
         """False when there is no parse/H2D to overlap: memory-resident
-        sources, and cache sources already materialized for this
-        (partition, projection) — the warm path stays queue-free."""
+        sources, cache sources already materialized for this
+        (partition, projection), and device-resident partitions (table
+        cache hit) — the warm path stays queue-free."""
         from ..io.cache import CacheSource
         from ..io.memory import MemTableSource
 
@@ -106,6 +107,10 @@ class ScanExec(PhysicalPlan):
             return False
         if isinstance(src, CacheSource) and \
                 src.is_materialized(partition, self.projection):
+            return False
+        is_resident = getattr(src, "is_resident", None)
+        if is_resident is not None and is_resident(partition,
+                                                   self.projection):
             return False
         return True
 
@@ -151,13 +156,24 @@ class ScanExec(PhysicalPlan):
             yield from bound_iter(
                 self.source.scan(partition, self.projection),
                 self._recorder())
+        else:
+            try:
+                yield from handle
+            finally:
+                # consumer may abandon the stream early (LimitExec):
+                # stop the producer instead of leaving it blocked on a
+                # full queue
+                handle.cancel()
+        self._record_cache_outcome(partition)
+
+    def _record_cache_outcome(self, partition: int) -> None:
+        from ..observability.metrics import metrics_enabled
+
+        if not metrics_enabled():
             return
-        try:
-            yield from handle
-        finally:
-            # consumer may abandon the stream early (LimitExec): stop
-            # the producer instead of leaving it blocked on a full queue
-            handle.cancel()
+        fn = getattr(self.source, "scan_cache_outcome", None)
+        if fn is not None and fn(partition) == "hit":
+            self.metrics().add_counter("table_cache_hits")
 
     def estimated_rows(self):
         return self.source.estimated_rows()
@@ -165,6 +181,23 @@ class ScanExec(PhysicalPlan):
     def display(self) -> str:
         p = f" projection={list(self.projection)}" if self.projection else ""
         return f"ScanExec: {self.table_name}{p}"
+
+    def pretty_metrics(self, indent: int = 0) -> str:
+        """EXPLAIN ANALYZE line with the device-residency outcome of
+        the latest scan(s) appended — deliberately NOT in display(),
+        which feeds compile signatures and must stay run-invariant."""
+        fn = getattr(self.source, "scan_cache_outcome", None)
+        outcomes = set()
+        if fn is not None:
+            for p in range(self.source.num_partitions()):
+                o = fn(p)
+                if o is not None:
+                    outcomes.add(o)
+        cache_ann = (f" [cache: {'|'.join(sorted(outcomes))}]"
+                     if outcomes else "")
+        ann = self.metrics().summary()
+        return ("  " * indent + self.display() + cache_ann
+                + (f", metrics=[{ann}]" if ann else "") + "\n")
 
 
 class FilterExec(PipelineOp):

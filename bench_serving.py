@@ -409,6 +409,14 @@ def main() -> int:
             executors=args.executors, slots=args.slots,
             max_running=args.max_running,
             session_quota=args.session_quota)
+    # warm-path cache effectiveness rides along on every line: a
+    # serving deployment that never hits its caches is leaving the
+    # memory-speed path on the table (docs/caching.md)
+    from ballista_tpu.cache import cache_counters
+    cc = cache_counters()
+    result["table_cache_hits"] = int(cc["table_cache_hits"])
+    result["result_cache_hits"] = int(cc["result_cache_hits"])
+    result["donated_buffers"] = int(cc["donated_buffers"])
     print(json.dumps(result), flush=True)
     return 0
 

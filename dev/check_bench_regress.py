@@ -108,6 +108,23 @@ METRICS: Dict[str, Tuple[str, float]] = {
     "autoscale_events": ("nonzero", 0.0),
     "autoscale_p99_seconds": ("lower", 0.50),
     "autoscale_errors": ("zero", 0.0),
+    # PR 19 (warm-path serving caches, docs/caching.md): the cache
+    # phase repeats q1 on a fresh residency tier. Warm/hit latencies
+    # and speedups must not silently regrow; the per-line counters and
+    # the byte-identity / budget-respect flags are aliveness gates (a
+    # cache that silently stops hitting, donating or evicting reads 0).
+    "cache_warm_q1_seconds": ("lower", 0.40),
+    "cache_q1_speedup": ("higher", 0.40),
+    "result_cache_hit_seconds": ("lower", 0.50),
+    "result_cache_speedup": ("higher", 0.50),
+    "table_cache_hits": ("nonzero", 0.0),
+    "result_cache_hits": ("nonzero", 0.0),
+    "donated_buffers": ("nonzero", 0.0),
+    "cache_q1_identical": ("nonzero", 0.0),
+    "result_cache_identical": ("nonzero", 0.0),
+    "cache_budget_identical": ("nonzero", 0.0),
+    "cache_budget_ok": ("nonzero", 0.0),
+    "cache_budget_evictions": ("nonzero", 0.0),
 }
 
 
@@ -291,6 +308,28 @@ def self_test() -> int:
     rows = {r[0]: r for r in compare({"recovery_errors": 0},
                                      {"recovery_errors": 1})}
     assert rows["recovery_errors"][4] is True
+    # cache phase (PR 19): warm latency is lower-is-better, speedup is
+    # higher-is-better — a faster warm run / bigger speedup never fails
+    rows = {r[0]: r for r in compare(
+        {"cache_warm_q1_seconds": 0.10, "cache_q1_speedup": 10.0},
+        {"cache_warm_q1_seconds": 0.30, "cache_q1_speedup": 2.0})}
+    assert rows["cache_warm_q1_seconds"][4] is True
+    assert rows["cache_q1_speedup"][4] is True
+    rows = {r[0]: r for r in compare(
+        {"result_cache_hit_seconds": 0.05, "result_cache_speedup": 5.0},
+        {"result_cache_hit_seconds": 0.01, "result_cache_speedup": 50.0})}
+    assert not any(r[4] for r in rows.values())
+    # identity / budget-respect flags and the live counters are
+    # aliveness gates: a drop to 0 regresses, a smaller count does not
+    rows = {r[0]: r for r in compare(
+        {"cache_q1_identical": 1, "cache_budget_ok": 1,
+         "donated_buffers": 18, "table_cache_hits": 4},
+        {"cache_q1_identical": 0, "cache_budget_ok": 1,
+         "donated_buffers": 0, "table_cache_hits": 1})}
+    assert rows["cache_q1_identical"][4] is True
+    assert rows["cache_budget_ok"][4] is False
+    assert rows["donated_buffers"][4] is True
+    assert rows["table_cache_hits"][4] is False
     print("self-test ok")
     return 0
 

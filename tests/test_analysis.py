@@ -261,6 +261,74 @@ def test_sync_span_matrix(tmp_path):
     assert lines == sorted(lines)
 
 
+def test_h2d_discipline_matrix(tmp_path):
+    """Scan-source uploads must sit behind serve_or_fill: direct
+    uploads in scan(), or in a module that never routes through the
+    residency layer, are findings; produce-callback uploads and
+    non-scan modules (shuffle codecs) are not."""
+    unrouted = """
+        from ..columnar import ColumnBatch
+
+        class RogueSource:
+            def scan(self, partition):
+                yield from self._parts[partition]
+
+            @classmethod
+            def from_data(cls, schema, data):
+                return [ColumnBatch.from_numpy(schema, data, {}, 8)]
+    """
+    routed = """
+        import jax.numpy as jnp
+        from ..columnar import ColumnBatch
+        from ..cache.residency import serve_or_fill
+
+        class GoodSource:
+            def scan(self, partition):
+                yield from serve_or_fill(
+                    self._key(partition),
+                    lambda: self._scan_direct(partition))
+
+            def _scan_direct(self, partition):
+                yield ColumnBatch.from_numpy(
+                    self._schema, self._arrays[partition], {}, 8)
+
+        class FrontRunner:
+            def scan(self, partition):
+                for arr in self._arrays[partition]:
+                    yield jnp.asarray(arr)  # upload BEFORE the layer
+    """
+    codec = """
+        import jax.numpy as jnp
+
+        def decode(vals):
+            return jnp.asarray(vals)  # shuffle wire codec: no scan
+    """
+    pkg = _pkg(tmp_path, {
+        "fixpkg/io/unrouted.py": unrouted,
+        "fixpkg/io/routed.py": routed,
+        "fixpkg/io/codec.py": codec,
+    })
+    res = _run(pkg, analysis.RULE_FACTORIES["h2d-discipline"]())
+    by_file = {}
+    for f in res.findings:
+        by_file.setdefault(f.file, []).append(f.message)
+    assert list(by_file.get("fixpkg/io/unrouted.py", [])), by_file
+    assert "never routes through" in by_file["fixpkg/io/unrouted.py"][0]
+    assert len(by_file.get("fixpkg/io/routed.py", [])) == 1, by_file
+    assert "in front of the residency layer" in \
+        by_file["fixpkg/io/routed.py"][0]
+    assert "fixpkg/io/codec.py" not in by_file
+
+
+def test_h2d_discipline_real_tree_clean():
+    """The live io/ sources hold the discipline (memory.py's
+    registration-time upload is the one triaged baseline entry)."""
+    pkg = analysis.Package.load(REPO)
+    res = _run(pkg, analysis.RULE_FACTORIES["h2d-discipline"]())
+    files = sorted({f.file for f in res.findings})
+    assert files == ["ballista_tpu/io/memory.py"], files
+
+
 # ---------------------------------------------------------------------------
 # lock-discipline fixtures
 # ---------------------------------------------------------------------------

@@ -1,0 +1,255 @@
+"""Warm-path serving caches (docs/caching.md): correctness contracts.
+
+The three tiers share ONE invalidation signal — file signatures are
+re-stat'd at lookup and plan fingerprints ride ``compile_signature`` —
+so the contracts tested here are exactly the ones an operator relies
+on: a changed file is NEVER served stale, every tier is byte-identical
+on vs off (q1/q5/q16, standalone AND LocalCluster), donation never
+changes results, and a starved budget degrades to plain re-ingest —
+queries slow down, they do not fail.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from benchmarks.tpch import datagen
+from benchmarks.tpch.schema_def import register_tpch
+
+QDIR = os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                    "tpch", "queries")
+
+
+def _q(qname: str) -> str:
+    return open(os.path.join(QDIR, f"{qname}.sql")).read()
+
+
+@pytest.fixture(scope="session")
+def tpch_dir(tmp_path_factory):
+    data_dir = str(tmp_path_factory.mktemp("tpch_cache"))
+    datagen.generate(data_dir, scale=0.002, num_parts=2)
+    return data_dir
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tiers():
+    """Every test starts and ends with empty tiers and released budget
+    so fills from other tests (or other FILES in the same process)
+    never leak into counters asserted here."""
+    from ballista_tpu.cache import residency, results
+
+    residency._reset_for_tests()
+    results.process_result_cache().invalidate()
+    yield
+    residency._reset_for_tests()
+    results.process_result_cache().invalidate()
+
+
+def _standalone(data_dir, **settings):
+    from ballista_tpu.client import BallistaContext
+
+    ctx = BallistaContext("standalone", settings=settings or None)
+    register_tpch(ctx, data_dir, "tbl")
+    return ctx
+
+
+# -- invalidation: a changed file is never served stale ---------------------
+
+
+def _write_kv(path, rows):
+    with open(path, "w") as f:
+        f.write("k,v\n")
+        for k, v in rows:
+            f.write(f"{k},{v}\n")
+
+
+def _kv_ctx(path, **settings):
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.datatypes import Field, Float64, Int64, Schema
+
+    ctx = BallistaContext("standalone", settings=settings or None)
+    ctx.register_csv("kv", str(path),
+                     Schema([Field("k", Int64), Field("v", Float64)]))
+    return ctx
+
+
+def test_table_cache_rewrite_mid_session_misses(tmp_path):
+    """File rewrite between two collects of the SAME session: the
+    second scan must re-read (the signature mints a new key), and the
+    stale pinned entry must not satisfy it."""
+    from ballista_tpu.cache import residency
+
+    path = tmp_path / "kv.csv"
+    _write_kv(path, [(1, 10.0), (2, 20.0)])
+    ctx = _kv_ctx(path)
+    df = ctx.sql("SELECT SUM(v) AS s FROM kv")
+    assert float(df.collect()["s"][0]) == 30.0
+    cache = residency.process_table_cache()
+    assert cache.stats()["fills"] >= 1
+
+    _write_kv(path, [(1, 10.0), (2, 20.0), (3, 70.0)])
+    assert float(df.collect()["s"][0]) == 100.0  # append seen
+
+    _write_kv(path, [(1, 1.5)])
+    assert float(df.collect()["s"][0]) == 1.5  # rewrite seen
+
+
+def test_result_cache_file_change_mid_session_misses(tmp_path):
+    """The result tier re-stats source files at lookup: a hit is only
+    legal while every input file signature still matches."""
+    from ballista_tpu.cache import cache_counters, reset_cache_stats
+
+    path = tmp_path / "kv.csv"
+    _write_kv(path, [(1, 2.0), (2, 3.0)])
+    ctx = _kv_ctx(path, **{"result_cache.enabled": "on"})
+    df = ctx.sql("SELECT SUM(v) AS s FROM kv")
+
+    reset_cache_stats()
+    first = df.collect()
+    again = df.collect()
+    cc = cache_counters()
+    assert cc["result_cache_hits"] == 1
+    assert first.equals(again)
+
+    _write_kv(path, [(1, 2.0), (2, 3.0), (3, 5.0)])
+    changed = df.collect()
+    cc = cache_counters()
+    assert cc["result_cache_hits"] == 1  # no stale hit
+    assert float(changed["s"][0]) == 10.0
+
+
+# -- byte-identity: every tier on vs off, standalone and cluster ------------
+
+IDENTITY_QUERIES = ["q1", "q5", "q12", "q16"]
+
+
+def _caches_off(monkeypatch):
+    monkeypatch.setenv("BALLISTA_TABLE_CACHE", "off")
+    monkeypatch.setenv("BALLISTA_DONATION", "off")
+    monkeypatch.setenv("BALLISTA_RESULT_CACHE", "off")
+
+
+def _caches_on(monkeypatch):
+    monkeypatch.setenv("BALLISTA_TABLE_CACHE", "on")
+    monkeypatch.setenv("BALLISTA_DONATION", "on")
+    monkeypatch.setenv("BALLISTA_RESULT_CACHE", "on")
+
+
+@pytest.mark.parametrize("qname", IDENTITY_QUERIES)
+def test_identity_standalone_caches_on_vs_off(tpch_dir, monkeypatch,
+                                              qname):
+    from ballista_tpu.cache import residency
+
+    _caches_off(monkeypatch)
+    baseline = _standalone(tpch_dir).sql(_q(qname)).collect()
+
+    _caches_on(monkeypatch)
+    residency._reset_for_tests()
+    ctx = _standalone(tpch_dir)
+    df = ctx.sql(_q(qname))
+    cold = df.collect()   # fills the table (and result) tiers
+    warm = df.collect()   # table-cache + result-cache hit path
+    pd.testing.assert_frame_equal(cold, baseline)
+    pd.testing.assert_frame_equal(warm, baseline)
+
+
+@pytest.mark.parametrize("caches", ["off", "on"])
+def test_identity_cluster_caches_on_vs_off(tpch_dir, monkeypatch,
+                                           caches, tmp_path_factory):
+    """LocalCluster leg: executors fill/serve the process tiers; both
+    configurations must produce the exact same frames. The off leg
+    archives its frames for the on leg to diff against."""
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.distributed.executor import LocalCluster
+
+    archive = tmp_path_factory.getbasetemp() / "cache_cluster_baseline"
+    archive.mkdir(exist_ok=True)
+    (_caches_off if caches == "off" else _caches_on)(monkeypatch)
+
+    cluster = LocalCluster(num_executors=2, concurrent_tasks=2)
+    try:
+        ctx = BallistaContext.remote("localhost", cluster.port)
+        register_tpch(ctx, tpch_dir, "tbl")
+        for qname in IDENTITY_QUERIES:
+            df = ctx.sql(_q(qname))
+            got = df.collect()
+            again = df.collect()  # warm pass inside the same session
+            pd.testing.assert_frame_equal(again, got)
+            pkl = archive / f"{qname}.pkl"
+            if caches == "off":
+                got.to_pickle(pkl)
+            elif pkl.exists():
+                pd.testing.assert_frame_equal(got, pd.read_pickle(pkl))
+    finally:
+        cluster.shutdown()
+
+
+def test_donation_on_off_identity_and_counter(tpch_dir, monkeypatch):
+    from ballista_tpu.cache import cache_counters, reset_cache_stats
+
+    monkeypatch.setenv("BALLISTA_DONATION", "off")
+    base = _standalone(tpch_dir).sql(_q("q1")).collect()
+
+    monkeypatch.setenv("BALLISTA_DONATION", "on")
+    reset_cache_stats()
+    donated = _standalone(tpch_dir).sql(_q("q1")).collect()
+    pd.testing.assert_frame_equal(donated, base)
+    assert cache_counters()["donated_buffers"] > 0
+
+
+# -- budget pressure degrades, never fails ----------------------------------
+
+
+def test_governor_eviction_lru_and_dead_fill():
+    """Unit-level governor contract: coldest-first eviction makes room,
+    an entry that cannot fit even after evicting everything dies
+    cleanly (refusal, zero residue), and accounting returns to zero."""
+    from ballista_tpu.cache.residency import DeviceTableCache
+
+    os.environ["BALLISTA_TABLE_CACHE_BUDGET_MB"] = "1"
+    os.environ["BALLISTA_TABLE_CACHE_WATERMARK"] = "1.0"
+    try:
+        cache = DeviceTableCache()
+        batch = lambda kb: np.zeros(kb << 10, dtype=np.uint8)  # noqa: E731
+
+        fa = cache.begin_fill(("t", "a"))
+        assert fa.add(batch(600)) and fa.commit()
+        fb = cache.begin_fill(("t", "b"))
+        assert fb.add(batch(600)) and fb.commit()  # evicts a (coldest)
+        assert cache.stats()["evictions"] == 1
+        assert not cache.contains(("t", "a"))
+        assert cache.contains(("t", "b"))
+
+        fc = cache.begin_fill(("t", "c"))
+        assert fc.add(batch(2048)) is False  # dead: larger than budget
+        assert not fc.commit()
+        assert cache.stats()["refusals"] >= 1
+        assert not cache.contains(("t", "c"))
+
+        cache.invalidate()
+        assert cache.governor.resident_bytes == 0
+    finally:
+        os.environ.pop("BALLISTA_TABLE_CACHE_BUDGET_MB", None)
+        os.environ.pop("BALLISTA_TABLE_CACHE_WATERMARK", None)
+
+
+def test_starved_budget_degrades_to_reingest(tpch_dir, monkeypatch):
+    """Engine-level: a watermark so low every fill is refused must
+    leave queries correct and unpinned — re-ingest, never an error."""
+    from ballista_tpu.cache import residency
+
+    baseline = _standalone(tpch_dir).sql(_q("q1")).collect()
+
+    monkeypatch.setenv("BALLISTA_TABLE_CACHE_BUDGET_MB", "1")
+    monkeypatch.setenv("BALLISTA_TABLE_CACHE_WATERMARK", "0.01")
+    residency._reset_for_tests()
+    df = _standalone(tpch_dir).sql(_q("q1"))
+    starved = df.collect()
+    starved2 = df.collect()
+    pd.testing.assert_frame_equal(starved, baseline)
+    pd.testing.assert_frame_equal(starved2, baseline)
+    stats = residency.process_table_cache().stats()
+    assert stats["refusals"] > 0 or stats["evictions"] > 0
+    assert stats["resident_bytes"] <= int(0.01 * (1 << 20))

@@ -413,6 +413,58 @@ def test_autoscaler_rows_in_system_table():
         svc.close_health()
 
 
+def test_autoscaler_backlog_counts_only_admittable_queue():
+    """Scale-up demand consults per-session admission quotas: a pile of
+    jobs queued behind ONE tenant's max_session_jobs must not buy
+    executors no quota would let it use, while multi-tenant backlog
+    still counts in full."""
+    from ballista_tpu.distributed.admission import AdmissionController
+
+    ctl = AdmissionController(state=None)
+    s1 = {"session.id": "s1", "admission.max_session_jobs": "2"}
+    assert ctl.gate("j1", s1).action == "admit"
+    assert ctl.gate("j2", s1).action == "admit"
+    # five more from the same session: all queue, but ZERO are
+    # admittable — s1 already holds its two slots
+    for i in range(5):
+        assert ctl.gate(f"jq{i}", s1).action == "queue"
+    assert ctl.queue_depth() == 5
+    assert ctl.admittable_queue_depth() == 0
+
+    # a second tenant queued on CLUSTER concurrency is real demand
+    s2 = {"session.id": "s2", "admission.max_running_jobs": "2"}
+    assert ctl.gate("k1", s2).action == "queue"
+    assert ctl.queue_depth() == 6
+    assert ctl.admittable_queue_depth() == 1
+
+    # virtual slots: a freed s1 slot makes exactly ONE of the five
+    # queued s1 jobs admittable, not all five
+    ctl.on_terminal("j1")
+    assert ctl.admittable_queue_depth() == 2
+
+    # unquota'd sessions always count in full
+    s3 = {"session.id": "s3", "admission.max_running_jobs": "1"}
+    assert ctl.gate("m1", s3).action == "queue"
+    assert ctl.admittable_queue_depth() == 3
+
+    # the scheduler's autoscaler signal uses the admittable variant
+    svc = SchedulerService(SchedulerState(MemoryBackend()))
+    try:
+        svc.attach_autoscaler(
+            AutoscalerConfig(enabled=True, min_executors=0,
+                             max_executors=2, backlog_tasks=1),
+            spawn_fn=lambda: None, drain_fn=lambda: None, start=False)
+        sess = {"session.id": "t1", "admission.max_session_jobs": "1",
+                "admission.enabled": "on"}
+        assert svc.admission.gate("b1", sess).action == "admit"
+        assert svc.admission.gate("b2", sess).action == "queue"
+        assert svc.admission.queue_depth() == 1
+        # quota-blocked backlog is invisible to the scaling signal
+        assert svc.autoscaler.signal_fn()["backlog"] == 0
+    finally:
+        svc.close_health()
+
+
 def test_subprocess_launcher_spawn_and_drain(tmp_path):
     # against a dead port: the executor binary starts, backs off, and
     # SIGTERM drains it — the launcher only manages processes
