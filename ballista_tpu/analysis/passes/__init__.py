@@ -12,6 +12,7 @@ from typing import Callable, Dict, List
 from ..engine import Rule
 from .cancel_coverage import CancelCoverageRule
 from .h2d_discipline import H2dDisciplineRule
+from .lane_coverage import LaneCoverageRule
 from .lock_discipline import LockDisciplineRule
 from .shape import (
     DictSitesRule,
@@ -27,6 +28,7 @@ from .sync_span import SyncSpanRule
 RULE_FACTORIES: Dict[str, Callable[[], Rule]] = {
     CancelCoverageRule.id: CancelCoverageRule,
     SyncSpanRule.id: SyncSpanRule,
+    LaneCoverageRule.id: LaneCoverageRule,
     H2dDisciplineRule.id: H2dDisciplineRule,
     LockDisciplineRule.id: LockDisciplineRule,
     JitSitesRule.id: JitSitesRule,

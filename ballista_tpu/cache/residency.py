@@ -234,16 +234,21 @@ class DeviceTableCache:
         may drop it mid-stream)."""
         if key is None or not table_cache_enabled():
             return None
-        with self._lock:
-            e = self._entries.get(key)
-            if e is None:
-                self.misses += 1
-                return None
-            self._entries.move_to_end(key)
-            e.hits += 1
-            e.last_access = time.time()
-            self.hits += 1
-            return list(e.batches)
+        from ..observability import trace_span
+
+        # spanned so the latency ledger's cache_lookup phase (and the
+        # flight recorder) sees every probe, hit or miss
+        with trace_span("cache.lookup", tier="table"):
+            with self._lock:
+                e = self._entries.get(key)
+                if e is None:
+                    self.misses += 1
+                    return None
+                self._entries.move_to_end(key)
+                e.hits += 1
+                e.last_access = time.time()
+                self.hits += 1
+                return list(e.batches)
 
     def contains(self, key: Optional[tuple]) -> bool:
         """Membership probe WITHOUT touching hit/miss counters or LRU

@@ -157,17 +157,22 @@ class ResultCache:
     def lookup(self, key: Optional[tuple]) -> Optional[dict]:
         if key is None:
             return None
-        with self._lock:
-            e = self._entries.get(key)
-            if e is None:
-                self.misses += 1
-                return None
-            self._entries.move_to_end(key)
-            e.hits += 1
-            e.last_access = time.time()
-            self.hits += 1
-            data = e.data
-        return _copy_pydict(data)
+        from ..observability import trace_span
+
+        # spanned so the latency ledger's cache_lookup phase (and the
+        # flight recorder) sees every probe, hit or miss
+        with trace_span("cache.lookup", tier="result"):
+            with self._lock:
+                e = self._entries.get(key)
+                if e is None:
+                    self.misses += 1
+                    return None
+                self._entries.move_to_end(key)
+                e.hits += 1
+                e.last_access = time.time()
+                self.hits += 1
+                data = e.data
+            return _copy_pydict(data)
 
     def fill(self, key: Optional[tuple], data: dict) -> bool:
         if key is None:

@@ -81,6 +81,13 @@ class BallistaContext:
         # job id of the last remote query: the handle df.profile() and
         # /debug/profile/<job_id> take on the cluster path
         self._last_job_id = None
+        # latency ledger (observability/ledger.py): standalone collects
+        # stash their assembled ledger; remote collects stash only the
+        # client envelope (wall + client-side stamps) and
+        # last_query_ledger() merges it with the scheduler's
+        # system.latency rows LAZILY — no RPC on the collect path
+        self._last_query_ledger = None
+        self._last_ledger_env = None
         # query lifecycle (lifecycle.py / docs/robustness.md): cancel
         # tokens of in-flight standalone collects and the live job-id
         # sinks of in-flight remote collects — what ctx.cancel() fires
@@ -315,10 +322,15 @@ class BallistaContext:
             out, _ = self._standalone_collect(plan,
                                               on_progress=on_progress)
             return out
+        import time as _time
+
         from .distributed.client import remote_collect
+        from .observability import ledger as _ledger
 
         sink: list = []
         jsink: list = []
+        _ledger.begin_collect()
+        t0 = _time.perf_counter()
         # jsink receives the job id at SUBMIT time, so a concurrent
         # ctx.cancel() can CancelJob the job while this thread waits
         with self._track_lifecycle(jsink, self._active_job_sinks):
@@ -328,6 +340,9 @@ class BallistaContext:
         self._last_query_metrics = sink[0] if sink else None
         self._last_query_phys = None
         self._last_job_id = jsink[0] if jsink else None
+        self._last_query_ledger = None
+        self._last_ledger_env = {"wall": _time.perf_counter() - t0,
+                                 "stamps": _ledger.take_collect()}
         return out
 
     def job_progress(self, job_id: Optional[str] = None):
@@ -394,12 +409,16 @@ class BallistaContext:
                 sampler.finish("cancelled" if isinstance(e, QueryCancelled)
                                else "failed")
             rec.finish("failed", error=e)
+            self._last_query_ledger = rec.ledger
+            self._last_ledger_env = None
             raise
         if sampler is not None:
             # terminal callback BEFORE the recorder tears the handle
             # down: the final snapshot reports fraction exactly 1.0
             sampler.finish("completed")
         rec.finish("completed", result=out, phys=phys2)
+        self._last_query_ledger = rec.ledger
+        self._last_ledger_env = None
         return out, phys2
 
     def _standalone_collect_routed(self, plan: LogicalPlan, phys, rec):
@@ -474,18 +493,21 @@ class BallistaContext:
         from .execution import collect_physical, plan_logical
         from .observability.metrics import (metrics_enabled,
                                             reset_plan_metrics)
+        from .observability.ledger import ledger_phase
         from .physical.planner import PlannerOptions
 
-        if phys is None:
-            phys = plan_logical(plan,
-                                PlannerOptions.from_settings(self.settings))
-        # whole-stage fusion (physical/fusion.py): merge each pipeline
-        # stage into one governed XLA program. Before prewarm (which
-        # targets fused-stage signatures) and before the adaptive pass
-        # (fused stages survive re-planning via with_new_children).
-        from .physical.fusion import maybe_fuse
+        with ledger_phase("planning"):
+            if phys is None:
+                phys = plan_logical(
+                    plan, PlannerOptions.from_settings(self.settings))
+            # whole-stage fusion (physical/fusion.py): merge each
+            # pipeline stage into one governed XLA program. Before
+            # prewarm (which targets fused-stage signatures) and before
+            # the adaptive pass (fused stages survive re-planning via
+            # with_new_children).
+            from .physical.fusion import maybe_fuse
 
-        phys = maybe_fuse(phys)
+            phys = maybe_fuse(phys)
         # plan-fingerprint result cache (cache/results.py, opt-in): a
         # repeat of the same fused plan over unchanged files with the
         # same settings returns the stored pydict without executing.
@@ -502,7 +524,9 @@ class BallistaContext:
             cached = _results.process_result_cache().lookup(rc_key)
             if cached is not None:
                 self._annotate_cache_hits(result_hit=True)
-                return pd.DataFrame(cached), phys
+                with ledger_phase("host_decode"):
+                    out = pd.DataFrame(cached)
+                return out, phys
         if metrics_enabled():
             # cached plans re-execute: last_query_metrics() must report
             # THIS query, not the lifetime accumulation — and the reset
@@ -543,7 +567,8 @@ class BallistaContext:
 
             obs_progress.attach_current_plan(phys)
             data = collect_physical(phys)
-            out = pd.DataFrame(data)
+            with ledger_phase("host_decode"):
+                out = pd.DataFrame(data)
         finally:
             cancel_plan(phys)
         self._record_plan_metrics(phys)
@@ -632,6 +657,62 @@ class BallistaContext:
             self._last_query_metrics = snapshot_plan_metrics(
                 self._last_query_phys)
         return self._last_query_metrics
+
+    def last_query_ledger(self):
+        """The per-query latency ledger of the most recent query this
+        context ran (docs/observability.md): the fixed phase schema
+        (``observability.ledger.LEDGER_PHASES``) plus wall seconds and
+        the unattributed remainder, or None before any query / under
+        ``BALLISTA_LEDGER=0``. Standalone queries stash the assembled
+        ledger at terminal time; remote queries fetch the scheduler's
+        ``system.latency`` rows for the job LAZILY here and merge them
+        with the client envelope (end-to-end wall, result transfer,
+        host decode) — nothing on the collect hot path."""
+        if self._last_query_ledger is None and self.mode == "remote" \
+                and self._last_job_id and self._last_ledger_env:
+            self._last_query_ledger = self._fetch_remote_ledger()
+        return self._last_query_ledger
+
+    def _fetch_remote_ledger(self):
+        import time as _time
+
+        from .observability import ledger as _ledger
+
+        env = self._last_ledger_env
+        job_id = self._last_job_id
+        # completion is published before the scheduler's terminal hook
+        # records the job ledger (results never wait on observability)
+        # — briefly retry until the job's rows appear
+        rows = []
+        deadline = _time.time() + 5.0
+        while True:
+            try:
+                from .distributed.client import fetch_system_table
+
+                rows = [r for r in fetch_system_table(
+                            self.host, self.port, "system.latency")
+                        if r.get("job_id") == job_id]
+            except Exception:  # noqa: BLE001 - ledger is advisory
+                rows = []
+            if rows or _time.time() > deadline:
+                break
+            _time.sleep(0.1)
+        phases = {}
+        status = "completed"
+        for r in rows:
+            phase = r.get("phase")
+            if phase and phase != "unattributed":
+                try:
+                    phases[phase] = float(r.get("seconds") or 0.0)
+                except (TypeError, ValueError):
+                    continue
+            status = r.get("status") or status
+        # the client envelope: end-to-end wall + client-side stamps
+        # (result_transfer, host_decode) the scheduler never sees
+        for k, v in (env.get("stamps") or {}).items():
+            phases[k] = phases.get(k, 0.0) + float(v)
+        return _ledger.build_ledger(job_id, env["wall"], origin="client",
+                                    status=status, phases=phases)
 
 
 def _is_ddl(query: str) -> bool:
@@ -761,10 +842,15 @@ class DataFrame:
         and are best-effort: a raising callback is logged, never the
         query's problem. The final callback reports fraction 1.0."""
         if self._raw_sql is not None:
+            import time as _time
+
             from .distributed.client import remote_sql_collect
+            from .observability import ledger as _ledger
 
             sink: list = []
             jsink: list = []
+            _ledger.begin_collect()
+            t0 = _time.perf_counter()
             with self.ctx._track_lifecycle(jsink,
                                            self.ctx._active_job_sinks):
                 out = remote_sql_collect(
@@ -775,6 +861,11 @@ class DataFrame:
             self.ctx._last_query_metrics = sink[0] if sink else None
             self.ctx._last_query_phys = None
             self.ctx._last_job_id = jsink[0] if jsink else None
+            self.ctx._last_query_ledger = None
+            self.ctx._last_ledger_env = {
+                "wall": _time.perf_counter() - t0,
+                "stamps": _ledger.take_collect(),
+            }
             return out
         if self.ctx.mode == "standalone":
             out, self._phys = self.ctx._standalone_collect(

@@ -195,6 +195,10 @@ class AdmissionController:
         # shed_fn(decision): move an already-accepted (queued) job to
         # its terminal shed state — wired to the scheduler service
         self.shed_fn = shed_fn
+        # queue_wait_fn(job_id, wait_secs): stamp the admitted job's
+        # queue wait into its latency ledger (observability/ledger.py)
+        # — wired by the scheduler, best-effort
+        self.queue_wait_fn: Optional[Callable[[str, float], None]] = None
         self._lock = threading.RLock()
         self._queue: List[Decision] = []
         self._active_session: Dict[str, str] = {}  # job_id -> session
@@ -639,6 +643,12 @@ class AdmissionController:
             self.on_terminal(d.job_id)
             return
         self._observe_wait(d, now, "admitted")
+        if self.queue_wait_fn is not None:
+            try:
+                self.queue_wait_fn(d.job_id,
+                                   max(now - d.enqueued_at, 0.0))
+            except Exception:  # noqa: BLE001 - ledger is advisory
+                pass
         log.info("admitting queued job %s after %.1fs (reason was %s)",
                  d.job_id, now - d.enqueued_at, d.reason)
         if self.launch_fn is not None:

@@ -476,37 +476,47 @@ def _fetch_result_frames(result: pb.GetJobStatusResult):
         result.status.completed.partition_location,
         key=lambda l: l.partition_id.partition_id,
     )
+    # latency ledger: the client envelope separates moving result bytes
+    # (result_transfer) from turning them into host arrays/DataFrames
+    # (host_decode); stamps no-op outside an active collect window
+    from ..observability.ledger import ledger_phase
+
     frames = []
     for loc in locations:
-        if loc.path and os.path.exists(loc.path):
-            raw = open(loc.path, "rb").read()
-        else:
-            raw = fetch_partition_bytes(
-                loc.executor_meta.host, loc.executor_meta.port,
-                loc.partition_id.job_id, loc.partition_id.stage_id,
-                loc.partition_id.partition_id,
-            )
-        names, arrays, nulls, dicts, kinds = ipc.read_partition_arrays(raw)
-        cols = {}
-        for name in names:
-            kind, scale = kinds.get(name, ("", 0))
-            from ..columnar import decode_physical_array
-
-            if kind.startswith("list:"):
-                from ..columnar import decode_list_rows
-
-                cols[name] = decode_list_rows(
-                    arrays[name], kind.split(":", 1)[1], scale, nulls[name]
+        with ledger_phase("result_transfer"):
+            if loc.path and os.path.exists(loc.path):
+                raw = open(loc.path, "rb").read()
+            else:
+                raw = fetch_partition_bytes(
+                    loc.executor_meta.host, loc.executor_meta.port,
+                    loc.partition_id.job_id, loc.partition_id.stage_id,
+                    loc.partition_id.partition_id,
                 )
-                continue
-            cols[name] = decode_physical_array(
-                arrays[name],
-                "utf8" if name in dicts else kind,
-                scale,
-                dicts.get(name),
-                nulls[name],
-            )
-        frames.append(pd.DataFrame(cols))
+        with ledger_phase("host_decode"):
+            names, arrays, nulls, dicts, kinds = \
+                ipc.read_partition_arrays(raw)
+            cols = {}
+            for name in names:
+                kind, scale = kinds.get(name, ("", 0))
+                from ..columnar import decode_physical_array
+
+                if kind.startswith("list:"):
+                    from ..columnar import decode_list_rows
+
+                    cols[name] = decode_list_rows(
+                        arrays[name], kind.split(":", 1)[1], scale,
+                        nulls[name]
+                    )
+                    continue
+                cols[name] = decode_physical_array(
+                    arrays[name],
+                    "utf8" if name in dicts else kind,
+                    scale,
+                    dicts.get(name),
+                    nulls[name],
+                )
+            frames.append(pd.DataFrame(cols))
     if not frames:
         return pd.DataFrame()
-    return pd.concat(frames, ignore_index=True)
+    with ledger_phase("host_decode"):
+        return pd.concat(frames, ignore_index=True)

@@ -325,6 +325,15 @@ class SchedulerService:
         # system.queries / /debug/queries: queued rows carry their live
         # admission-queue position
         state.queue_info_fn = self.admission.queue_info
+        # latency ledger (observability/ledger.py): scheduler-side
+        # phase stamps (admission_wait/queue_wait/planning) accumulate
+        # here per job until the terminal hook assembles the full
+        # ledger; the admission pump stamps queue waits on admit
+        self._ledger_stamps = {}
+        self._ledger_lock = threading.Lock()
+        self.admission.queue_wait_fn = (
+            lambda job_id, wait: self._ledger_stamp(
+                job_id, "queue_wait", wait))
         self.tasks_dispatched = 0
         if metrics_port is None:
             metrics_port = metrics_port_from_env(-1)
@@ -539,6 +548,13 @@ class SchedulerService:
 
     # -- distributed profiler ------------------------------------------------
 
+    def _ledger_stamp(self, job_id: str, phase: str, secs: float) -> None:
+        """Accumulate one scheduler-side latency-ledger phase for
+        assembly at the job's terminal transition (best-effort)."""
+        with self._ledger_lock:
+            st = self._ledger_stamps.setdefault(job_id, {})
+            st[phase] = st.get(phase, 0.0) + float(secs)
+
     def _on_job_terminal(self, job_id: str, summary: dict, status) -> None:
         """state.profile_hook: runs once per job at its terminal
         transition, BEFORE the summary enters the query log. Observes
@@ -557,6 +573,21 @@ class SchedulerService:
         from ..observability.registry import observe_histogram
 
         self.profiles.finalize(job_id, summary)
+        # latency ledger: scheduler stamps + the summed per-task
+        # ``ledger.*`` deltas that rode CompletedTask profiles — cheap
+        # (no ring scan, no artifact work), so it runs inline and the
+        # job's rows are queryable the moment its status is terminal
+        try:
+            from ..observability import ledger as obs_ledger
+
+            with self._ledger_lock:
+                stamps = self._ledger_stamps.pop(job_id, {})
+            obs_ledger.record_ledger(obs_ledger.assemble_job_ledger(
+                job_id, float(summary.get("wall_seconds", 0.0)),
+                status.state, stamps,
+                self.profiles.task_payloads(job_id)))
+        except Exception:  # noqa: BLE001 - observability only
+            log.exception("ledger assembly failed for job %s", job_id)
         # admission plane: release the session's concurrency slot (and
         # any queue entry — a cancelled/reaped queued job leaves the
         # queue here), then pump so a freed slot admits waiting work
@@ -676,6 +707,13 @@ class SchedulerService:
                         self.profiles.set_artifact(job_id, art, path)
                         log.info("merged profile artifact for job %s: "
                                  "%s", job_id, path)
+                        if out_dir is None:
+                            # retroactive slow-query dump: keep the
+                            # directory bounded (hygiene knob)
+                            from ..observability.distributed import \
+                                prune_slow_query_artifacts
+
+                            prune_slow_query_artifacts(dest)
                 # the ring records the summary BY COPY at the terminal
                 # transition, usually before this build finishes: set
                 # the source dict (covers a build outrunning record)
@@ -740,6 +778,7 @@ class SchedulerService:
         # admission gate FIRST (needs only the settings): a shed must
         # not pay plan deserialization or persist any job state — the
         # submission never existed
+        t_gate = time.perf_counter()
         decision = self.admission.gate(job_id, settings,
                                        request.deadline_secs)
         if decision.action == "shed":
@@ -747,6 +786,10 @@ class SchedulerService:
             return pb.ExecuteQueryResult(
                 job_id=job_id, error=str(err),
                 retry_after_secs=err.retry_after_secs)
+        # latency ledger: gate time for accepted jobs (shed jobs never
+        # reach the terminal hook, so they carry no stamps)
+        self._ledger_stamp(job_id, "admission_wait",
+                           time.perf_counter() - t_gate)
         deadline_ts = None
         if request.deadline_secs > 0:
             # server-side deadline: armed BEFORE planning (a stuck plan
@@ -790,8 +833,11 @@ class SchedulerService:
         except BaseException:
             # the submission dies before it exists (bad plan proto):
             # release the gate's reservation or the session leaks a
-            # concurrency slot forever
+            # concurrency slot forever (and drop its ledger stamps —
+            # no terminal hook will ever pop them)
             self.admission.on_terminal(job_id)
+            with self._ledger_lock:
+                self._ledger_stamps.pop(job_id, None)
             raise
         if decision.action == "queue":
             # planning deferred: the pump launches (or sheds) it later;
@@ -946,6 +992,9 @@ class SchedulerService:
             log.info("job %s cancelled during planning; not enqueued",
                      job_id)
             return
+        # ledger stamp BEFORE the job becomes runnable: once enqueued,
+        # the terminal hook may pop the job's stamps at any moment
+        self._ledger_stamp(job_id, "planning", time.time() - t0)
         self.state.enqueue_job(job_id)
         # durable control plane: the full stage set + task rows are
         # persisted and the ready stages enqueued — restart recovery

@@ -156,6 +156,21 @@ def capture_task_profile(task_key: str, t0: float, wall: float,
         "compile": _compile_delta(compile_stats(), compile0 or {}),
         "memory": obs_memory.memory_snapshot(),
     }
+    # per-task latency-ledger deltas ride the same free-form phases
+    # dict as "ledger.<phase>" keys (no proto change): span-derived
+    # fetch/write/cache slices + the compile delta, with the remainder
+    # as device_execute. The scheduler sums them across tasks at
+    # job-terminal time (ledger.assemble_job_ledger).
+    try:
+        from . import ledger as _ledger
+
+        out["phases"].update(_ledger.task_ledger_phases(
+            matched, wall,
+            compile_seconds=float(
+                out["compile"].get("compile_seconds", 0.0)
+                + out["compile"].get("trace_seconds", 0.0))))
+    except Exception:  # noqa: BLE001 - observability only
+        log.exception("task ledger extraction failed")
     if truncated:
         out["records_truncated"] = truncated
     return out
@@ -361,6 +376,57 @@ def slow_query_dir() -> str:
     return d if d is not None else tempfile.gettempdir()
 
 
+def slow_query_max_artifacts() -> int:
+    """``BALLISTA_SLOW_QUERY_MAX_ARTIFACTS`` (default 32): cap on
+    retained slow-query dumps in ``slow_query_dir()`` — under sustained
+    overload every slow query writes one, so an uncapped directory
+    grows without bound. 0 disables pruning."""
+    try:
+        return max(int(os.environ.get(
+            "BALLISTA_SLOW_QUERY_MAX_ARTIFACTS", "32")), 0)
+    except ValueError:
+        return 32
+
+
+def prune_slow_query_artifacts(out_dir: Optional[str] = None) -> int:
+    """Delete the OLDEST ``ballista-profile-*.json`` dumps past the
+    max-artifacts cap (oldest by mtime — the newest dumps are the ones
+    an operator is about to look at). Only artifact-named files are
+    touched: the slow-query dir may be a shared profile dir. Returns
+    the number of files removed; never raises."""
+    cap = slow_query_max_artifacts()
+    if cap <= 0:
+        return 0
+    d = out_dir or slow_query_dir()
+    try:
+        names = [n for n in os.listdir(d)
+                 if n.startswith("ballista-profile-")
+                 and n.endswith(".json")]
+    except OSError:
+        return 0
+    if len(names) <= cap:
+        return 0
+    entries = []
+    for n in names:
+        path = os.path.join(d, n)
+        try:
+            entries.append((os.path.getmtime(path), path))
+        except OSError:  # raced a concurrent prune/delete
+            continue
+    entries.sort()
+    removed = 0
+    for _, path in entries[:max(len(entries) - cap, 0)]:
+        try:
+            os.remove(path)
+            removed += 1
+        except OSError:
+            continue
+    if removed:
+        log.info("pruned %d slow-query artifact(s) past the %d-file cap "
+                 "in %s", removed, cap, d)
+    return removed
+
+
 def dump_ring_artifact(label: str, t0: float, wall: float,
                        phases0: Optional[dict] = None,
                        compile0: Optional[dict] = None,
@@ -388,8 +454,10 @@ def dump_ring_artifact(label: str, t0: float, wall: float,
         "records": records,
         "flight_recorder": True,
     }
-    return export.write_artifact(session,
-                                 out_dir=out_dir or slow_query_dir())
+    dest = out_dir or slow_query_dir()
+    path = export.write_artifact(session, out_dir=dest)
+    prune_slow_query_artifacts(dest)
+    return path
 
 
 @contextmanager

@@ -62,6 +62,21 @@ from typing import Dict, List, Optional
 LANE_NAMES = ("parse", "h2d", "compile_trace_lower", "device_blocked",
               "host_dictionary", "shuffle_spill", "xla_execute_other")
 
+# Span name -> lane, the declarative face of compute_lanes below (which
+# also folds in phase-delta fallbacks and attr-based compile sums). The
+# lane-coverage analysis pass reads this map + ledger.LEDGER_SPANS to
+# flag span names that NO attribution surface maps — keep it in sync
+# with the span names compute_lanes consumes.
+LANE_SPANS = {
+    "ingest.parse": "parse",
+    "ingest.h2d": "h2d",
+    "compile.jit": "compile_trace_lower",
+    "compile.aot": "compile_trace_lower",
+    "device.block": "device_blocked",
+    "host.dictionary": "host_dictionary",
+    "shuffle.spill": "shuffle_spill",
+}
+
 
 def compute_lanes(session: dict) -> dict:
     """The named wall-time decomposition (see module docstring)."""

@@ -485,3 +485,100 @@ class QueryMetrics:
                     for s in self.stages.values())
         return (f"QueryMetrics(stages={self.stage_ids()}, "
                 f"operators={n_ops})")
+
+
+# -- SLO latency histograms with exemplars ------------------------------------
+# Fed once per terminal query from the latency ledger
+# (observability/ledger.py): a fixed-bucket end-to-end histogram plus a
+# per-phase family, where every (family, labels, bucket) cell retains
+# the job id + full ledger of its MOST RECENT observation — so the top
+# occupied bucket (the p99 tail) always carries a concrete exemplar
+# query instead of an anonymous count. Surfaced as system.exemplars.
+
+SLO_LATENCY_FAMILY = "ballista_latency_seconds"
+SLO_PHASE_FAMILY = "ballista_latency_phase_seconds"
+
+import threading as _threading  # noqa: E402 - section-local dependency
+
+_exemplar_lock = _threading.Lock()
+# (family, labels-key tuple, bucket index) -> exemplar dict. Bucket
+# index is the first HISTOGRAM_BUCKETS edge >= value; len(buckets) is
+# the +Inf overflow bucket.
+_exemplars: Dict[tuple, dict] = {}
+
+
+def _bucket_index(value: float) -> int:
+    from .registry import HISTOGRAM_BUCKETS
+
+    for i, le in enumerate(HISTOGRAM_BUCKETS):
+        if value <= le:
+            return i
+    return len(HISTOGRAM_BUCKETS)
+
+
+def _bucket_le(index: int) -> float:
+    from .registry import HISTOGRAM_BUCKETS
+
+    if index >= len(HISTOGRAM_BUCKETS):
+        return float("inf")
+    return HISTOGRAM_BUCKETS[index]
+
+
+def _note_exemplar(family: str, labels: Dict[str, str], value: float,
+                   ledger: dict) -> None:
+    key = (family,
+           tuple(sorted((str(k), str(v)) for k, v in labels.items())),
+           _bucket_index(value))
+    with _exemplar_lock:
+        _exemplars[key] = {
+            "job_id": ledger.get("job_id"),
+            "seconds": round(float(value), 6),
+            "wall_seconds": float(ledger.get("wall_seconds", 0.0)),
+            "ledger": ledger,
+        }
+
+
+def observe_query_ledger(ledger: dict) -> None:
+    """Observe one query's ledger into the SLO families: end-to-end
+    wall + every phase (zeros included, so ``_count`` is queries per
+    cell and phase fractions divide cleanly)."""
+    from .registry import observe_histogram
+
+    wall = float(ledger.get("wall_seconds", 0.0))
+    observe_histogram(SLO_LATENCY_FAMILY, {}, wall)
+    _note_exemplar(SLO_LATENCY_FAMILY, {}, wall, ledger)
+    for phase, secs in (ledger.get("phases") or {}).items():
+        labels = {"phase": phase}
+        observe_histogram(SLO_PHASE_FAMILY, labels, float(secs))
+        _note_exemplar(SLO_PHASE_FAMILY, labels, float(secs), ledger)
+
+
+def exemplar_rows() -> List[dict]:
+    """``system.exemplars``: one row per retained (family, labels,
+    bucket) exemplar, widest buckets last. ``ledger_json`` carries the
+    exemplar query's FULL ledger."""
+    import json
+
+    with _exemplar_lock:
+        snap = dict(_exemplars)
+    rows = []
+    for (family, labels_key, idx), ex in sorted(
+            snap.items(), key=lambda kv: (kv[0][0], kv[0][1], kv[0][2])):
+        rows.append({
+            "family": family,
+            "phase": dict(labels_key).get("phase", ""),
+            "bucket_le": _bucket_le(idx),
+            "job_id": ex.get("job_id"),
+            "seconds": ex.get("seconds"),
+            "wall_seconds": ex.get("wall_seconds"),
+            "ledger_json": json.dumps(ex.get("ledger") or {},
+                                      sort_keys=True),
+        })
+    return rows
+
+
+def reset_latency_exemplars() -> None:
+    """Test hook: drop retained exemplars (histogram cells are cleared
+    separately via registry.reset_histograms)."""
+    with _exemplar_lock:
+        _exemplars.clear()

@@ -146,6 +146,18 @@ PROCESS_METRICS = {
                                "summed task seconds per completed stage "
                                "(label stage=...), observed at job "
                                "completion"),
+    # always-on latency ledger (observability/ledger.py + metrics.py):
+    # SLO histograms observed once per terminal query; each bucket
+    # keeps its most recent worst-offender exemplar (system.exemplars)
+    "ballista_latency_seconds": ("histogram",
+                                 "end-to-end query wall seconds, "
+                                 "observed from the per-query latency "
+                                 "ledger at terminal time"),
+    "ballista_latency_phase_seconds": ("histogram",
+                                       "per-query ledger phase seconds "
+                                       "(label phase=admission_wait|"
+                                       "queue_wait|planning|compile|"
+                                       "device_execute|...)"),
     # admission plane (scheduler; distributed/admission.py)
     "ballista_admission_queue_depth": ("gauge", "submissions waiting in "
                                                 "the admission queue"),

@@ -163,6 +163,16 @@ KNOBS: Dict[str, tuple] = {
     "BALLISTA_SLOW_QUERY_DIR": ("profile dir, else tempdir",
                                 "where retroactive slow-query artifacts "
                                 "land"),
+    "BALLISTA_SLOW_QUERY_MAX_ARTIFACTS": ("32", "retained slow-query "
+                                                "dumps per directory; "
+                                                "oldest deleted past the "
+                                                "cap (0 = unbounded)"),
+    "BALLISTA_LEDGER": ("on", "always-on per-query latency ledger: phase "
+                              "attribution into system.latency + "
+                              "ballista_latency_* SLO histograms with "
+                              "exemplars"),
+    "BALLISTA_LEDGER_LOG": ("256", "recent query ledgers retained per "
+                                   "process (system.latency window)"),
     "BALLISTA_QUERY_LOG_DIR": ("off", "durable query-history log "
                                       "directory (JSON lines, size-capped "
                                       "rotation; feeds system.queries "
@@ -384,6 +394,21 @@ SYSTEM_SCHEMAS: Dict[str, Schema] = {
         ("executors", Int64), ("target", Int64), ("backlog", Int64),
         ("inflight_tasks", Int64), ("eta_seconds", Float64),
         ("drained", Utf8),
+    ),
+    # latency ledger (observability/ledger.py): one row per recent
+    # query per phase (plus an "unattributed" remainder row) — the
+    # always-on SLO attribution surface
+    "system.latency": make_schema(
+        ("job_id", Utf8), ("origin", Utf8), ("status", Utf8),
+        ("phase", Utf8), ("seconds", Float64), ("fraction", Float64),
+        ("wall_seconds", Float64),
+    ),
+    # SLO histogram exemplars (observability/metrics.py): the most
+    # recent worst offender per latency bucket, full ledger attached
+    "system.exemplars": make_schema(
+        ("family", Utf8), ("phase", Utf8), ("bucket_le", Float64),
+        ("job_id", Utf8), ("seconds", Float64),
+        ("wall_seconds", Float64), ("ledger_json", Utf8),
     ),
 }
 
@@ -733,19 +758,25 @@ class StandaloneQueryRecorder:
         self._phases0 = phase_totals()
         self._compile0 = compile_stats()
         self._t0 = time.time()
+        # latency ledger (ledger.py): open the thread-local stamp
+        # window the collect path writes planning/host_decode into;
+        # _finish_inner assembles + records the full ledger
+        self.ledger: Optional[dict] = None
+        from . import ledger as obs_ledger
+
+        obs_ledger.begin_collect()
         # live progress plane: register the collect with the in-flight
         # surfaces (system.tasks/stages, running system.queries rows);
         # the executed plan attaches once planned (attach_current_plan)
         self.handle = obs_progress.start_local_query(
             self.job_id, session_id, self.digest)
 
-    def _lanes(self, wall: float) -> Optional[dict]:
-        from . import tracing
+    def _lanes(self, wall: float, records) -> Optional[dict]:
         from ..compile import compile_stats
         from ..ingest import phase_totals
         from .export import compute_lanes
 
-        if not tracing.flight_recorder_enabled():
+        if records is None:
             return None
         phases1 = phase_totals()
         compile1 = compile_stats()
@@ -755,9 +786,41 @@ class StandaloneQueryRecorder:
                        for k in ("parse", "h2d")},
             "compile": {k: compile1.get(k, 0) - self._compile0.get(k, 0)
                         for k in ("compile_seconds", "trace_seconds")},
-            "records": tracing.ring_records(since=self._t0),
+            "records": records,
         }
         return compute_lanes(session)["lanes"]
+
+    def _build_ledger(self, wall: float, status: str, records) -> None:
+        """Assemble + record this collect's latency ledger: the TLS
+        stamp window (planning/host_decode) + span sums out of the SAME
+        ring extraction the lanes use + the compile governor delta,
+        with ``device_execute`` as the remainder — phases sum exactly
+        to the wall time."""
+        from . import ledger as obs_ledger
+        from ..compile import compile_stats
+
+        # always detach the window, even when recording is off — a
+        # stale window would soak up stamps from later unrecorded runs
+        stamps = obs_ledger.take_collect()
+        if not obs_ledger.ledger_enabled():
+            return
+        phases = dict(stamps)
+        if records:
+            for phase, secs in obs_ledger.span_phase_sums(
+                    records).items():
+                phases[phase] = phases.get(phase, 0.0) + secs
+        compile1 = compile_stats()
+        comp = sum(
+            float(compile1.get(k, 0.0)) - float(self._compile0.get(k, 0.0))
+            for k in ("compile_seconds", "trace_seconds"))
+        if comp > 0:
+            phases["compile"] = phases.get("compile", 0.0) + comp
+        measured = sum(phases.values())
+        phases["device_execute"] = max(0.0, wall - measured)
+        self.ledger = obs_ledger.build_ledger(
+            self.job_id, wall, origin="standalone", status=status,
+            phases=phases)
+        obs_ledger.record_ledger(self.ledger)
 
     def finish(self, status: str, result=None, phys=None,
                error: Optional[BaseException] = None) -> None:
@@ -778,11 +841,20 @@ class StandaloneQueryRecorder:
 
     def _finish_inner(self, status, result, phys, error) -> None:
         from . import memory as obs_memory
+        from . import tracing
 
         wall = time.time() - self._t0
+        # ONE ring extraction feeds both the lane decomposition and the
+        # ledger's span-derived phases
+        records = None
+        try:
+            if tracing.flight_recorder_enabled():
+                records = tracing.ring_records(since=self._t0)
+        except Exception:  # noqa: BLE001 - advisory
+            records = None
         lanes = None
         try:
-            lanes = self._lanes(wall)
+            lanes = self._lanes(wall, records)
         except Exception:  # noqa: BLE001 - lanes are advisory
             lanes = None
         # a cooperatively-cancelled query is terminal "cancelled", not a
@@ -794,6 +866,10 @@ class StandaloneQueryRecorder:
         if isinstance(error, QueryCancelled):
             status = "cancelled"
             cancel_reason = error.reason
+        try:
+            self._build_ledger(wall, status, records)
+        except Exception:  # noqa: BLE001 - observability only
+            pass
         rec = build_query_record(
             self.job_id, status, wall,
             plan_digest=self.digest,
@@ -1023,6 +1099,17 @@ class SystemSnapshot:
             return self._admission_fn()
         if table == "system.autoscaler":
             return self._autoscaler_fn()
+        if table == "system.latency":
+            # process-global ledger log: standalone queries land here
+            # directly; on the cluster path the scheduler assembles the
+            # job ledger at terminal time into its own process log
+            from . import ledger as _ledger
+
+            return _ledger.latency_rows()
+        if table == "system.exemplars":
+            from . import metrics as _metrics
+
+            return _metrics.exemplar_rows()
         return settings_rows()
 
 

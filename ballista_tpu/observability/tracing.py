@@ -217,12 +217,26 @@ def ring_records(since: Optional[float] = None,
     ring = _ring()
     if ring is None:
         return []
+    snap = list(ring)
+    if since is not None:
+        # records append at emit time — span END order (spans emit at
+        # __exit__ with end == ts + dur == now; events have dur 0) — so
+        # the ring is end-time ordered: walk from the RIGHT and stop at
+        # the first record ending before the window. Extraction cost is
+        # bounded by the WINDOW size, not the ring size (per-task and
+        # slow-query windows are tiny against a 4096-record ring).
+        cut = since - 1e-6
+        lo = len(snap)
+        while lo > 0:
+            r = snap[lo - 1]
+            if float(r.get("ts", 0.0)) + float(r.get("dur", 0.0)) < cut:
+                break
+            lo -= 1
+        snap = snap[lo:]
+    if job is None and task is None:
+        return snap
     out = []
-    for r in list(ring):
-        if since is not None and \
-                float(r.get("ts", 0.0)) + float(r.get("dur", 0.0)) < \
-                since - 1e-6:
-            continue
+    for r in snap:
         if job is not None and r.get("job") != job:
             continue
         if task is not None and r.get("task") != task:

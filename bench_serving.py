@@ -12,6 +12,13 @@ the admission gate. Prints ONE JSON line:
      "serving_p50_seconds": ..., "serving_p99_seconds": ...,
      "serving_sheds": ..., "serving_errors": ..., ...}
 
+The serving line also carries the always-on latency ledger's per-lane
+view (docs/observability.md): ``serving_<phase>_p50_seconds`` /
+``serving_<phase>_p99_seconds`` for every ledger phase, the number of
+storm ledgers observed (``serving_ledgers``) and ``p99_attribution`` —
+the lane(s) where the p99 exemplar query diverges most from the p50
+centroid, i.e. the place to look first when the tail regresses.
+
 ``--phase restart`` measures scheduler restart recovery over a durable
 sqlite backend: submit a mixed batch (one admitted + planned, the rest
 queued), abandon the service mid-flight, rebuild it on the same file
@@ -53,6 +60,47 @@ def _percentile(sorted_vals, p: float) -> float:
     return sorted_vals[idx]
 
 
+def _ledger_phase_vals(ledgers, phase: str):
+    """Sorted per-query seconds of one ledger phase (the synthetic
+    ``unattributed`` phase reads the remainder field)."""
+    if phase == "unattributed":
+        vals = [float(e.get("unattributed_seconds", 0.0))
+                for e in ledgers]
+    else:
+        vals = [float((e.get("phases") or {}).get(phase, 0.0))
+                for e in ledgers]
+    return sorted(vals)
+
+
+def _p99_attribution(ledgers) -> str:
+    """Name the lane(s) where the p99 exemplar query diverges most from
+    the per-lane p50 centroid — "where did the tail go". Lanes within
+    25% of the worst divergence all make the cut (joined with ``+``);
+    a perfectly flat tail falls back to the exemplar's largest lane,
+    so the attribution is non-empty whenever any ledger exists."""
+    if not ledgers:
+        return ""
+    from ballista_tpu.observability.ledger import LEDGER_PHASES
+
+    by_wall = sorted(ledgers,
+                     key=lambda e: float(e.get("wall_seconds", 0.0)))
+    exemplar = by_wall[min(int(round(0.99 * (len(by_wall) - 1))),
+                           len(by_wall) - 1)]
+    ex_phases = dict(exemplar.get("phases") or {})
+    ex_phases["unattributed"] = float(
+        exemplar.get("unattributed_seconds", 0.0))
+    divergence = {}
+    for phase in (*LEDGER_PHASES, "unattributed"):
+        p50 = _percentile(_ledger_phase_vals(ledgers, phase), 0.50)
+        divergence[phase] = float(ex_phases.get(phase, 0.0)) - p50
+    top = max(divergence.values())
+    if top <= 0:
+        return max(ex_phases, key=lambda p: ex_phases.get(p, 0.0))
+    return "+".join(p for p, d in sorted(divergence.items(),
+                                         key=lambda kv: -kv[1])
+                    if d >= 0.25 * top)
+
+
 def run_serving(data_dir: str, sessions: int = 4,
                 queries_per_session: int = 6, executors: int = 2,
                 slots: int = 2, max_running: int = 4,
@@ -65,11 +113,20 @@ def run_serving(data_dir: str, sessions: int = 4,
     from ballista_tpu.client import BallistaContext
     from ballista_tpu.distributed.executor import LocalCluster
     from ballista_tpu.errors import AdmissionRejected
+    from ballista_tpu.observability import ledger as obs_ledger
     from benchmarks.tpch.schema_def import register_tpch
 
     qdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "benchmarks", "tpch", "queries")
     sqls = {q: open(os.path.join(qdir, f"{q}.sql")).read() for q in mix}
+
+    # per-lane latency attribution: the LocalCluster's scheduler runs
+    # in-process, so its assembled job ledgers land in THIS process's
+    # ledger log — size it to hold the whole storm
+    os.environ.setdefault(
+        "BALLISTA_LEDGER_LOG",
+        str(max(4096, 2 * sessions * queries_per_session)))
+    obs_ledger.reset_process_log()
 
     cluster = LocalCluster(num_executors=executors,
                            concurrent_tasks=slots)
@@ -147,6 +204,12 @@ def run_serving(data_dir: str, sessions: int = 4,
         per_query = {}
         for q, s in latencies:
             per_query.setdefault(q, []).append(s)
+        # storm-window ledgers only (warmup recorded before t0): where
+        # each query's wall time went, phase by phase
+        ledgers = [e for e in
+                   obs_ledger.process_ledger_log().entries(since=t0)
+                   if e.get("origin") == "cluster"
+                   and e.get("status") == "completed"]
         result = {
             "metric": "serving_qps",
             "unit": "queries/s",
@@ -168,7 +231,15 @@ def run_serving(data_dir: str, sessions: int = 4,
             "serving_query_p50": {
                 q: round(_percentile(sorted(v), 0.5), 4)
                 for q, v in sorted(per_query.items())},
+            "serving_ledgers": len(ledgers),
+            "p99_attribution": _p99_attribution(ledgers),
         }
+        for phase in obs_ledger.LEDGER_PHASES:
+            vals = _ledger_phase_vals(ledgers, phase)
+            result[f"serving_{phase}_p50_seconds"] = round(
+                _percentile(vals, 0.50), 4)
+            result[f"serving_{phase}_p99_seconds"] = round(
+                _percentile(vals, 0.99), 4)
         if errors:
             result["serving_error_sample"] = str(errors[:3])[:300]
         return result

@@ -95,6 +95,19 @@ METRICS: Dict[str, Tuple[str, float]] = {
     # engine errors during the storm must stay ZERO (sheds are counted
     # separately — they are policy, not errors)
     "serving_errors": ("zero", 0.0),
+    # PR 20 (latency ledger, docs/observability.md): the serving line
+    # carries per-lane p50/p99 from the always-on per-query ledger. The
+    # dominant lanes must not silently regrow (generous tolerance —
+    # single-lane seconds are noisier than the end-to-end percentile),
+    # and a storm that records no ledgers means the always-on
+    # attribution plane is dead. Zero-baseline lanes (a workload that
+    # never queued, say) are skipped by the o<=0 ratio-gate rule.
+    "serving_ledgers": ("nonzero", 0.0),
+    "serving_device_execute_p99_seconds": ("lower", 0.60),
+    "serving_compile_p99_seconds": ("lower", 0.60),
+    "serving_planning_p99_seconds": ("lower", 0.60),
+    "serving_queue_wait_p99_seconds": ("lower", 0.60),
+    "serving_shuffle_fetch_p99_seconds": ("lower", 0.60),
     # PR 17 (durable control plane): bench_serving.py --phase restart
     # times the rehydrate+recover gap of a scheduler restart over
     # sqlite; recovered_jobs reads 0 if the journal or the recovery
@@ -330,6 +343,28 @@ def self_test() -> int:
     assert rows["cache_budget_ok"][4] is False
     assert rows["donated_buffers"][4] is True
     assert rows["table_cache_hits"][4] is False
+    # ledger lanes (PR 20): lower-is-better with a generous tolerance —
+    # a lane p99 that more than doubles regresses, one that shrinks
+    # never does, and a zero-baseline lane (never exercised) is skipped
+    # rather than tripping a divide-by-zero ratio
+    rows = {r[0]: r for r in compare(
+        {"serving_device_execute_p99_seconds": 1.0,
+         "serving_compile_p99_seconds": 0.5,
+         "serving_queue_wait_p99_seconds": 0.0},
+        {"serving_device_execute_p99_seconds": 2.5,
+         "serving_compile_p99_seconds": 0.2,
+         "serving_queue_wait_p99_seconds": 0.4})}
+    assert rows["serving_device_execute_p99_seconds"][4] is True
+    assert rows["serving_compile_p99_seconds"][4] is False
+    assert rows["serving_queue_wait_p99_seconds"][5] is False  # skipped
+    # serving_ledgers is an aliveness gate: the always-on plane going
+    # silent regresses; recording fewer ledgers does not
+    rows = {r[0]: r for r in compare({"serving_ledgers": 24},
+                                     {"serving_ledgers": 0})}
+    assert rows["serving_ledgers"][4] is True
+    rows = {r[0]: r for r in compare({"serving_ledgers": 24},
+                                     {"serving_ledgers": 6})}
+    assert rows["serving_ledgers"][4] is False
     print("self-test ok")
     return 0
 

@@ -1,0 +1,513 @@
+"""Per-query latency ledger tests (PR 20 tentpole + satellites).
+
+Covers: the standalone path's fixed-schema ledger whose phases sum to
+the measured wall time; assembly primitives (normalization, per-task
+delta extraction, the cross-executor merge, the scheduler's job-terminal
+assembly); ``ledger.*`` deltas riding TaskProfile.phases through the
+proto round-trip unchanged; the process LedgerLog behind
+``system.latency``; SLO histograms + the exemplar store behind
+``system.exemplars`` (full-ledger JSON round-trip, most-recent-wins,
++Inf bucket); a LocalCluster e2e (scheduler-assembled ledgers queryable
+over SQL, ``ctx.last_query_ledger()`` fetching the client-merged view);
+the ring right-walk micro-test (extraction cost bounded by WINDOW size,
+not ring size); the slow-query artifact cap (flood stays bounded, knob
+registered in ``system.settings``); and the drift-cancelling < 5%
+warm-q1 overhead gate flipping ``BALLISTA_LEDGER``.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from ballista_tpu.client import BallistaContext
+from ballista_tpu.datatypes import Float64, Int64, Utf8, schema
+from ballista_tpu.observability import ledger as obs_ledger
+from ballista_tpu.observability import metrics as obs_metrics
+from ballista_tpu.observability import registry as obs_registry
+from ballista_tpu.observability import tracing as obs_tracing
+from ballista_tpu.proto import ballista_pb2 as pb
+from ballista_tpu import serde
+
+
+@pytest.fixture
+def ctx():
+    c = BallistaContext.standalone()
+    c.register_memtable(
+        "t", schema(("k", Utf8), ("a", Int64), ("b", Float64)),
+        {"k": ["x", "y", "z"] * 20,
+         "a": list(range(60)),
+         "b": [float(i) / 4 for i in range(60)]},
+    )
+    return c
+
+
+@pytest.fixture
+def ledger_env():
+    """Restore ledger enablement + log capacity however a test mangles
+    them, and leave the process log/exemplar store fresh on both sides."""
+    saved = {k: os.environ.get(k)
+             for k in ("BALLISTA_LEDGER", "BALLISTA_LEDGER_LOG")}
+    obs_ledger.reset_process_log()
+    obs_metrics.reset_latency_exemplars()
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    obs_ledger.reconfigure()
+    obs_ledger.reset_process_log()
+    obs_metrics.reset_latency_exemplars()
+
+
+def _ledger_total(led):
+    return sum(led["phases"].values()) + led["unattributed_seconds"]
+
+
+# ---------------------------------------------------------------------------
+# standalone path: schema + phases sum to wall
+# ---------------------------------------------------------------------------
+
+
+def test_standalone_ledger_schema_and_sum(ctx, ledger_env):
+    out = ctx.sql("SELECT k, sum(a) AS s FROM t GROUP BY k").collect()
+    assert len(out) == 3
+    led = ctx.last_query_ledger()
+    assert led is not None
+    assert led["origin"] == "standalone"
+    assert led["status"] == "completed"
+    assert set(led["phases"]) == set(obs_ledger.LEDGER_PHASES)
+    assert led["wall_seconds"] > 0.0
+    # the standalone recorder attributes the unexplained remainder to
+    # device_execute, so phases + unattributed reconstruct the wall
+    # exactly (up to per-phase rounding)
+    assert abs(_ledger_total(led) - led["wall_seconds"]) < 1e-4, led
+    assert led["phases"]["planning"] >= 0.0
+    assert led["phases"]["host_decode"] > 0.0  # DataFrame materialization
+    # cluster-only phases stay present but zero
+    assert led["phases"]["queue_wait"] == 0.0
+    assert led["phases"]["shuffle_fetch"] == 0.0
+    # the same ledger landed in the process log (system.latency source)
+    last = obs_ledger.process_ledger_log().last()
+    assert last is not None and last["job_id"] == led["job_id"]
+
+
+def test_standalone_ledger_rows_via_sql(ctx, ledger_env):
+    ctx.sql("SELECT sum(a) AS s FROM t").collect()
+    led = ctx.last_query_ledger()
+    ctx._plan_cache.clear()
+    rows = ctx.sql(
+        "SELECT job_id, phase, seconds, fraction, wall_seconds "
+        "FROM system.latency").collect()
+    mine = rows[rows["job_id"] == led["job_id"]]
+    # one row per phase plus the explicit unattributed remainder
+    assert set(mine["phase"]) == \
+        set(obs_ledger.LEDGER_PHASES) | {"unattributed"}
+    assert (mine["seconds"] >= 0.0).all()
+    assert (mine["fraction"] <= 1.0 + 1e-9).all()
+
+
+def test_ledger_disabled_records_nothing(ctx, ledger_env):
+    os.environ["BALLISTA_LEDGER"] = "0"
+    obs_ledger.reconfigure()
+    before = len(obs_ledger.process_ledger_log().entries())
+    ctx.sql("SELECT sum(a) AS s FROM t").collect()
+    assert ctx.last_query_ledger() is None
+    assert len(obs_ledger.process_ledger_log().entries()) == before
+
+
+# ---------------------------------------------------------------------------
+# assembly primitives
+# ---------------------------------------------------------------------------
+
+
+def test_build_ledger_normalizes_to_fixed_schema():
+    led = obs_ledger.build_ledger(
+        "job-1", 2.0, "cluster", "completed",
+        phases={"compile": 0.5, "device_execute": 1.0,
+                "bogus_phase": 9.0, "queue_wait": -0.25,
+                "planning": "not-a-number"})
+    assert set(led["phases"]) == set(obs_ledger.LEDGER_PHASES)
+    assert "bogus_phase" not in led["phases"]
+    assert led["phases"]["queue_wait"] == 0.0  # negatives clamp
+    assert led["phases"]["planning"] == 0.0    # junk drops
+    assert led["unattributed_seconds"] == pytest.approx(0.5)
+    assert _ledger_total(led) == pytest.approx(led["wall_seconds"])
+
+
+def test_task_ledger_phases_extracts_spans_and_remainder():
+    records = [
+        {"name": "shuffle.fetch", "dur": 0.10},
+        {"name": "shuffle.fetch", "dur": 0.05},
+        {"name": "dataplane.write", "dur": 0.20},
+        {"name": "cache.lookup", "dur": 0.01},
+        {"name": "executor.task", "dur": 1.0},  # envelope: not a phase
+    ]
+    deltas = obs_ledger.task_ledger_phases(records, 1.0,
+                                           compile_seconds=0.25)
+    assert deltas["ledger.shuffle_fetch"] == pytest.approx(0.15)
+    assert deltas["ledger.shuffle_write"] == pytest.approx(0.20)
+    assert deltas["ledger.cache_lookup"] == pytest.approx(0.01)
+    assert deltas["ledger.compile"] == pytest.approx(0.25)
+    # device_execute is the task's unattributed remainder
+    assert deltas["ledger.device_execute"] == pytest.approx(0.39)
+    assert all(k.startswith("ledger.") for k in deltas)
+
+
+def test_merge_task_phases_sums_across_executors():
+    # two executors' worth of per-task payloads: summing IS the merge
+    # (phases are disjoint slices of task wall time); non-ledger phase
+    # keys (ingest counters etc.) must be ignored
+    payloads = [
+        {"phases": {"ledger.shuffle_fetch": 0.1,
+                    "ledger.device_execute": 0.4, "parse": 9.0}},
+        {"phases": {"ledger.shuffle_fetch": 0.3,
+                    "ledger.compile": 0.2, "ledger.junk_val": "x"}},
+        {"phases": None},
+    ]
+    merged = obs_ledger.merge_task_phases(payloads)
+    assert merged == {"shuffle_fetch": pytest.approx(0.4),
+                      "device_execute": pytest.approx(0.4),
+                      "compile": pytest.approx(0.2)}
+    led = obs_ledger.assemble_job_ledger(
+        "job-2", 2.0, "completed",
+        stamps={"queue_wait": 0.5, "planning": 0.1},
+        task_payloads=payloads)
+    assert led["phases"]["queue_wait"] == pytest.approx(0.5)
+    assert led["phases"]["shuffle_fetch"] == pytest.approx(0.4)
+    assert led["unattributed_seconds"] == pytest.approx(0.4)
+
+
+def test_ledger_deltas_survive_task_profile_proto():
+    # the deltas ride TaskProfile.phases as ledger.* keys — no proto
+    # change — and must come back float-typed and byte-identical
+    phases = {"parse": 0.5,
+              "ledger.shuffle_fetch": 0.123456,
+              "ledger.device_execute": 1.5}
+    profile = {"t0": 10.0, "wall_seconds": 2.0, "pid": 42,
+               "role": "executor", "executor_id": "exec-1",
+               "records": [], "phases": phases, "compile": {},
+               "memory": {}}
+    msg = pb.TaskProfile()
+    serde.task_profile_to_proto(profile, msg)
+    back = serde.task_profile_from_proto(msg)
+    assert back["phases"] == phases
+    merged = obs_ledger.merge_task_phases([back])
+    assert merged == {"shuffle_fetch": pytest.approx(0.123456),
+                      "device_execute": pytest.approx(1.5)}
+
+
+# ---------------------------------------------------------------------------
+# the process log (system.latency source)
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_log_capacity_and_rows(ledger_env):
+    log = obs_ledger.LedgerLog(capacity=2)
+    for i in range(3):
+        log.record(obs_ledger.build_ledger(
+            f"job-{i}", 1.0, "standalone", "completed",
+            phases={"device_execute": 0.5}))
+    entries = log.entries()
+    assert [e["job_id"] for e in entries] == ["job-1", "job-2"]
+    rows = log.rows()
+    # one row per retained query per phase + the unattributed row
+    assert len(rows) == 2 * (len(obs_ledger.LEDGER_PHASES) + 1)
+    unattr = [r for r in rows if r["phase"] == "unattributed"]
+    assert all(r["seconds"] == pytest.approx(0.5) for r in unattr)
+    assert all(r["fraction"] == pytest.approx(0.5) for r in unattr)
+
+
+def test_ledger_log_since_filter(ledger_env):
+    log = obs_ledger.LedgerLog(capacity=8)
+    log.record(obs_ledger.build_ledger("old", 1.0, "x", "completed"))
+    cut = time.time()
+    log.record(obs_ledger.build_ledger("new", 1.0, "x", "completed"))
+    assert [e["job_id"] for e in log.entries(since=cut)] == ["new"]
+
+
+# ---------------------------------------------------------------------------
+# SLO histograms + exemplar store (system.exemplars source)
+# ---------------------------------------------------------------------------
+
+
+def test_exemplar_store_roundtrip_and_most_recent_wins(ledger_env):
+    obs_registry.reset_histograms()
+    led_a = obs_ledger.build_ledger("job-a", 0.3, "cluster", "completed",
+                                    phases={"compile": 0.2})
+    led_b = obs_ledger.build_ledger("job-b", 0.4, "cluster", "completed",
+                                    phases={"compile": 0.15})
+    obs_metrics.observe_query_ledger(led_a)
+    obs_metrics.observe_query_ledger(led_b)  # same 0.5s bucket: b wins
+    rows = obs_metrics.exemplar_rows()
+    wall = [r for r in rows
+            if r["family"] == obs_metrics.SLO_LATENCY_FAMILY
+            and r["bucket_le"] == 0.5]
+    assert len(wall) == 1 and wall[0]["job_id"] == "job-b"
+    # ledger_json carries the exemplar query's FULL ledger
+    back = json.loads(wall[0]["ledger_json"])
+    assert back == led_b
+    # every phase family cell retained an exemplar too
+    phase_rows = [r for r in rows
+                  if r["family"] == obs_metrics.SLO_PHASE_FAMILY]
+    assert {r["phase"] for r in phase_rows} == \
+        set(obs_ledger.LEDGER_PHASES)
+    # and the histograms counted both queries in every cell
+    snap = obs_registry.histogram_snapshot()
+    cells = snap[obs_metrics.SLO_LATENCY_FAMILY]
+    assert len(cells) == 1 and cells[0][3] == 2  # count == 2 queries
+
+
+def test_exemplar_inf_bucket(ledger_env):
+    obs_registry.reset_histograms()
+    led = obs_ledger.build_ledger("job-slow", 500.0, "cluster",
+                                  "completed",
+                                  phases={"device_execute": 500.0})
+    obs_metrics.observe_query_ledger(led)
+    rows = [r for r in obs_metrics.exemplar_rows()
+            if r["family"] == obs_metrics.SLO_LATENCY_FAMILY]
+    assert rows and rows[-1]["bucket_le"] == float("inf")
+    assert rows[-1]["job_id"] == "job-slow"
+    # the +Inf sentinel survives the system-table float column
+    assert json.loads(rows[-1]["ledger_json"])["wall_seconds"] == 500.0
+
+
+def test_histogram_merge_across_executor_observations(ledger_env):
+    # in a cluster every completed job is observed once, at the
+    # scheduler — but multiple schedulers/processes can scrape-merge by
+    # bucket addition. Verify bucket counts are additive and cumulative.
+    obs_registry.reset_histograms()
+    for wall in (0.04, 0.2, 0.2, 3.0):
+        obs_metrics.observe_query_ledger(obs_ledger.build_ledger(
+            "j", wall, "cluster", "completed"))
+    (_, counts, total, n), = \
+        obs_registry.histogram_snapshot()[obs_metrics.SLO_LATENCY_FAMILY]
+    buckets = obs_registry.HISTOGRAM_BUCKETS
+    assert n == 4 and total == pytest.approx(3.44)
+    assert counts[buckets.index(0.05)] == 1   # cumulative: <= 0.05
+    assert counts[buckets.index(0.25)] == 3   # 0.04 + two 0.2s
+    assert counts[buckets.index(5.0)] == 4    # everything
+    assert counts == sorted(counts)           # cumulative monotone
+
+
+# ---------------------------------------------------------------------------
+# satellite: ring right-walk — extraction cost bounded by window size
+# ---------------------------------------------------------------------------
+
+
+class _CountingRecord(dict):
+    """Ring record that counts field reads: ring_records(since=...) must
+    examine O(window) records, not O(ring)."""
+    reads = [0]
+
+    def get(self, k, default=None):
+        if k in ("ts", "dur"):
+            type(self).reads[0] += 1
+        return dict.get(self, k, default)
+
+
+def test_ring_records_since_walks_only_the_window():
+    saved = os.environ.get("BALLISTA_FLIGHT_RECORDER")
+    os.environ.pop("BALLISTA_FLIGHT_RECORDER", None)
+    obs_tracing.reconfigure()
+    try:
+        assert obs_tracing.flight_recorder_enabled()  # default on
+        ring = obs_tracing._ring()
+        snap_before = list(ring)
+        ring.clear()
+        n_old, n_window = 3000, 16
+        for i in range(n_old):
+            ring.append(_CountingRecord(name="old", ts=100.0 + i * 1e-3,
+                                        dur=0.0))
+        since = 1000.0
+        for i in range(n_window):
+            ring.append(_CountingRecord(name="new", ts=since + i,
+                                        dur=0.0))
+        _CountingRecord.reads[0] = 0
+        out = obs_tracing.ring_records(since=since)
+        assert len(out) == n_window
+        assert all(r["name"] == "new" for r in out)
+        # right-walk: window records + the ONE old record that stops the
+        # walk are examined (2 field reads each) — nothing near n_old
+        assert _CountingRecord.reads[0] <= 2 * (n_window + 1), \
+            _CountingRecord.reads[0]
+    finally:
+        ring = obs_tracing._ring()
+        if ring is not None:
+            ring.clear()
+            ring.extend(snap_before)
+        if saved is not None:
+            os.environ["BALLISTA_FLIGHT_RECORDER"] = saved
+        obs_tracing.reconfigure()
+
+
+# ---------------------------------------------------------------------------
+# satellite: slow-query artifact flood stays bounded
+# ---------------------------------------------------------------------------
+
+
+def test_slow_artifact_flood_capped(tmp_path, monkeypatch):
+    from ballista_tpu.observability import distributed as obs_dist
+
+    d = tmp_path / "slow"
+    d.mkdir()
+    monkeypatch.setenv("BALLISTA_SLOW_QUERY_DIR", str(d))
+    monkeypatch.setenv("BALLISTA_SLOW_QUERY_MAX_ARTIFACTS", "5")
+    for i in range(12):
+        p = d / f"ballista-profile-{i:03d}.json"
+        p.write_text("{}")
+        os.utime(p, (1000 + i, 1000 + i))
+    # a bystander file in the shared dir must never be touched
+    (d / "keep.txt").write_text("x")
+    removed = obs_dist.prune_slow_query_artifacts()
+    assert removed == 7
+    kept = sorted(n for n in os.listdir(d)
+                  if n.startswith("ballista-profile-"))
+    # the NEWEST survive — the dumps an operator is about to look at
+    assert kept == [f"ballista-profile-{i:03d}.json"
+                    for i in range(7, 12)]
+    assert (d / "keep.txt").exists()
+    # repeated floods stay bounded (the cap is enforced per dump)
+    for i in range(12, 20):
+        (d / f"ballista-profile-{i:03d}.json").write_text("{}")
+        obs_dist.prune_slow_query_artifacts()
+        n = len([x for x in os.listdir(d)
+                 if x.startswith("ballista-profile-")])
+        assert n <= 5
+    # 0 disables pruning entirely
+    monkeypatch.setenv("BALLISTA_SLOW_QUERY_MAX_ARTIFACTS", "0")
+    (d / "ballista-profile-999.json").write_text("{}")
+    assert obs_dist.prune_slow_query_artifacts() == 0
+
+
+def test_slow_artifact_cap_knob_registered(ctx):
+    rows = ctx.sql(
+        "SELECT name, value FROM system.settings").collect()
+    names = set(rows["name"])
+    assert {"BALLISTA_SLOW_QUERY_MAX_ARTIFACTS", "BALLISTA_LEDGER",
+            "BALLISTA_LEDGER_LOG"} <= names, names
+
+
+# ---------------------------------------------------------------------------
+# cluster path: scheduler-assembled ledgers, SQL + client fetch
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_ledger_end_to_end(tmp_path, ledger_env):
+    from ballista_tpu.distributed.executor import LocalCluster
+
+    csv = tmp_path / "t.csv"
+    with open(csv, "w") as f:
+        f.write("k,a\n")
+        for i in range(40):
+            f.write(f"{'xy'[i % 2]},{i}\n")
+
+    cluster = LocalCluster(num_executors=2, metrics_port=0)
+    try:
+        ctx = BallistaContext.remote("localhost", cluster.port)
+        ctx.register_csv("t", str(csv), schema(("k", Utf8), ("a", Int64)))
+        out = ctx.sql(
+            "SELECT k, sum(a) AS s FROM t GROUP BY k ORDER BY k"
+        ).collect()
+        assert list(out["s"]) == [380, 400]
+        job_id = ctx._last_job_id
+        assert job_id
+
+        # the client-merged view: scheduler phases + client envelope
+        led = ctx.last_query_ledger()
+        assert led is not None, "remote ledger fetch came back empty"
+        assert led["job_id"] == job_id and led["origin"] == "client"
+        assert led["status"] == "completed"
+        assert set(led["phases"]) == set(obs_ledger.LEDGER_PHASES)
+        assert led["wall_seconds"] > 0.0
+        # the executor side attributed real work (on a tiny query the
+        # device_execute remainder can clamp to 0 when the process-wide
+        # compile delta dominates each task's wall, so assert on the
+        # executor-derived mass, not one phase)...
+        exec_mass = (led["phases"]["device_execute"]
+                     + led["phases"]["compile"]
+                     + led["phases"]["shuffle_write"]
+                     + led["phases"]["shuffle_fetch"])
+        assert exec_mass > 0.0, led
+        # ...the multi-stage plan wrote shuffle partitions...
+        assert led["phases"]["shuffle_write"] > 0.0
+        # ...and the client stamped its envelope
+        assert led["phases"]["host_decode"] > 0.0
+        assert led["unattributed_seconds"] >= 0.0
+
+        # scheduler's LedgerLog serves system.latency over plain SQL
+        ctx._plan_cache.clear()
+        rows = ctx.sql(
+            "SELECT job_id, origin, status, phase, seconds "
+            "FROM system.latency").collect()
+        mine = rows[rows["job_id"] == job_id]
+        assert set(mine["phase"]) == \
+            set(obs_ledger.LEDGER_PHASES) | {"unattributed"}
+        assert set(mine["origin"]) == {"cluster"}
+        assert set(mine["status"]) == {"completed"}
+
+        # and every job fed the exemplar store with its full ledger
+        ctx._plan_cache.clear()
+        ex_rows = ctx.sql(
+            "SELECT family, phase, bucket_le, job_id, ledger_json "
+            "FROM system.exemplars").collect()
+        assert len(ex_rows) > 0
+        full = json.loads(ex_rows.iloc[0]["ledger_json"])
+        assert set(full["phases"]) == set(obs_ledger.LEDGER_PHASES)
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# overhead gate: warm q1, ledger on vs off, < 5%
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_overhead_q1_under_5pct(tmp_path_factory, ledger_env):
+    from benchmarks.tpch import datagen
+    from benchmarks.tpch.schema_def import register_tpch
+
+    data_dir = str(tmp_path_factory.mktemp("tpch_ledger"))
+    datagen.generate(data_dir, scale=0.01, num_parts=1)
+    ctx = BallistaContext.standalone()
+    register_tpch(ctx, data_dir, "tbl")
+    qdir = os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                        "tpch", "queries")
+    df = ctx.sql(open(os.path.join(qdir, "q1.sql")).read())
+    df.collect()  # warm: jit compile + table caches
+
+    def sample(flag):
+        os.environ["BALLISTA_LEDGER"] = flag
+        obs_ledger.reconfigure()
+        t0 = time.perf_counter()
+        for _ in range(3):
+            df.collect()
+        return time.perf_counter() - t0
+
+    sample("1")
+    sample("0")
+
+    def measure():
+        # interleaved pairs with alternating order so load spikes and
+        # monotonic ramps hit both sides equally; medians absorb the
+        # rest (same drift-cancelling shape as the metrics gate)
+        offs, ons = [], []
+        for i in range(9):
+            if i % 2 == 0:
+                offs.append(sample("0"))
+                ons.append(sample("1"))
+            else:
+                ons.append(sample("1"))
+                offs.append(sample("0"))
+        return sorted(offs)[4], sorted(ons)[4]
+
+    for attempt in range(3):
+        t_off, t_on = measure()
+        if t_on <= t_off * 1.05 + 2e-3:
+            return
+    overhead = (t_on - t_off) / t_off
+    raise AssertionError(
+        f"ledger overhead {overhead:.1%} (on={t_on:.4f}s off={t_off:.4f}s)"
+    )
